@@ -1,0 +1,43 @@
+"""distributed_tensorflow_example_tpu — a TPU-native distributed training framework.
+
+A from-scratch reimplementation of the capabilities of the classic
+parameter-server distributed-TensorFlow example
+(``Amano-Ginji/distributed-tensorflow-example``, see ``SURVEY.md``), designed
+idiomatically for TPU hardware on JAX/XLA:
+
+- The PS/worker gRPC topology (``tf.train.ClusterSpec`` / ``tf.train.Server``,
+  SURVEY.md §2.2) becomes a :class:`~.cluster.ClusterSpec` +
+  :class:`~.runtime.server.Server` parity layer that maps the legacy
+  ``--job_name/--task_index`` CLI onto JAX process / TPU-slice coordinates.
+- ``tf.train.SyncReplicasOptimizer``'s accumulate-N-then-apply-then-barrier
+  protocol (SURVEY.md §2.2, §3.3) becomes a single jit-compiled train step in
+  :mod:`~.parallel.sync_replicas` whose gradient mean rides one fused XLA
+  all-reduce over ICI instead of O(params) point-to-point RecvTensor RPCs.
+- Round-robin PS variable placement (``tf.train.replica_device_setter``)
+  becomes :mod:`~.parallel.sharding` NamedSharding rules over a device mesh
+  (replicated, fsdp-sharded, or tensor-parallel).
+- ``Supervisor`` / ``MonitoredTrainingSession`` scaffolding (hooks, checkpoint
+  threads, steps/sec counters) becomes :mod:`~.train.trainer` +
+  :mod:`~.train.hooks` + :mod:`~.ckpt`.
+
+Import alias convention used throughout docs and tests::
+
+    import distributed_tensorflow_example_tpu as dtx
+"""
+
+__version__ = "0.1.0"
+
+from . import config as config
+from .cluster import ClusterSpec
+from .parallel.mesh import MeshConfig, build_mesh, AxisNames
+from .train.state import TrainState
+
+__all__ = [
+    "__version__",
+    "config",
+    "ClusterSpec",
+    "MeshConfig",
+    "build_mesh",
+    "AxisNames",
+    "TrainState",
+]

@@ -1,0 +1,5 @@
+"""Checkpointing: Saver parity (SURVEY.md §3.4, §5.4)."""
+
+from .checkpoint import CheckpointManager, latest_checkpoint, restore_or_init
+
+__all__ = ["CheckpointManager", "latest_checkpoint", "restore_or_init"]

@@ -1,0 +1,223 @@
+"""Checkpoint save/restore with the reference Saver's semantics.
+
+Parity map (SURVEY.md §3.4/§5.4 → here):
+
+- graph-embedded SaveV2 op, chief fetches params from PS  →  process 0
+  device_gets the state pytree and writes one ``.npz`` (path-keyed leaves)
+  plus a small JSON sidecar (step, leaf metadata).
+- ``model.ckpt-N`` + ``checkpoint`` state file  →  ``ckpt-N.npz`` + a JSON
+  ``checkpoint`` file recording ``latest`` and ``all_model_checkpoint_paths``.
+- ``max_to_keep=5`` ring (saver.py:448)  →  same ring, same default.
+- restore-or-init decision (SessionManager.prepare_session:320-335)  →
+  :func:`restore_or_init`.
+- non-chief never writes  →  only ``jax.process_index() == 0`` writes;
+  everyone restores (state is replicated/resharded on load).
+
+Format note: npz (zip of npy) keeps this dependency-free and inspectable;
+keys are ``/``-joined pytree paths. PRNG-key leaves are serialized via
+``jax.random.key_data`` and rewrapped on load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..utils.pytree import is_prng_key as _is_key, path_str as _path_str
+
+PyTree = Any
+
+STATE_FILE = "checkpoint"          # parity with TF's 'checkpoint' proto file
+PREFIX = "ckpt"
+
+
+def _to_host(leaf) -> np.ndarray:
+    """Fetch a (possibly multi-host-sharded) array to this host. For
+    non-fully-addressable arrays (fsdp over processes) every process must
+    participate in the gather — mirroring how the reference's SaveV2
+    fetched params *from the PS* to the chief (SURVEY.md §3.4)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            leaf, tiled=True))
+    return np.asarray(jax.device_get(leaf))
+
+
+def _flatten(state: PyTree) -> dict[str, np.ndarray]:
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    out: dict[str, np.ndarray] = {}
+    for path, leaf in flat:
+        key = _path_str(path)
+        if _is_key(leaf):
+            out["__prngkey__/" + key] = np.asarray(jax.random.key_data(leaf))
+        else:
+            out[key] = _to_host(leaf)
+    return out
+
+
+def _unflatten(template: PyTree, arrays: dict[str, np.ndarray]) -> PyTree:
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tleaf in paths_and_leaves:
+        key = _path_str(path)
+        if "__prngkey__/" + key in arrays:
+            leaf = jax.random.wrap_key_data(
+                np.asarray(arrays["__prngkey__/" + key]))
+        elif key in arrays:
+            leaf = arrays[key]
+            if hasattr(tleaf, "shape") and tuple(leaf.shape) != tuple(tleaf.shape):
+                raise ValueError(
+                    f"checkpoint leaf {key!r} shape {leaf.shape} != "
+                    f"template {tleaf.shape}")
+        else:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        leaves.append(leaf)
+    restored = jax.tree_util.tree_unflatten(treedef, leaves)
+    # re-place on the template's shardings when it is device-resident
+    def place(t, r):
+        if isinstance(t, jax.Array) and hasattr(t, "sharding") and not _is_key(t):
+            return jax.device_put(r, t.sharding)
+        if _is_key(t):
+            return r
+        return jax.numpy.asarray(r)
+    return jax.tree_util.tree_map(place, template, restored)
+
+
+class CheckpointManager:
+    """Write/restore checkpoints with a max_to_keep ring.
+
+    Thread-safe save (the trainer's time-based saver thread mirrors the
+    reference's SVTimerCheckpointThread, supervisor.py:1098).
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 5,
+                 keep_every_n_hours: float = 0.0):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self.keep_every_n_hours = keep_every_n_hours
+        self._lock = threading.Lock()
+        # start the keep-forever clock now (TF Saver semantics): the first
+        # interval must actually elapse before a checkpoint is pinned
+        self._last_kept_forever = time.time()
+        if self.is_writer:
+            os.makedirs(directory, exist_ok=True)
+
+    @property
+    def is_writer(self) -> bool:
+        return jax.process_index() == 0
+
+    # -- state file -------------------------------------------------------
+    def _state(self) -> dict:
+        p = os.path.join(self.directory, STATE_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {"latest": None, "all_model_checkpoint_paths": [],
+                "kept_forever": []}
+
+    def _write_state(self, st: dict) -> None:
+        p = os.path.join(self.directory, STATE_FILE)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(st, f, indent=1)
+        os.replace(tmp, p)
+
+    def checkpoint_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{PREFIX}-{step}.npz")
+
+    def all_steps(self) -> list[int]:
+        st = self._state()
+        steps = []
+        for p in st["all_model_checkpoint_paths"] + st.get("kept_forever", []):
+            m = re.search(rf"{PREFIX}-(\d+)\.npz$", p)
+            if m and os.path.exists(os.path.join(self.directory, p)):
+                steps.append(int(m.group(1)))
+        return sorted(set(steps))
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save / restore ---------------------------------------------------
+    def save(self, state: PyTree, step: int | None = None) -> str | None:
+        """Gather to host and write ``ckpt-<step>.npz``; rotate the ring.
+        Non-writer processes only participate in the device_get (so all
+        hosts stay in lockstep) and return None."""
+        if step is None:
+            step = int(jax.device_get(state.step))
+        arrays = _flatten(state)
+        if not self.is_writer:
+            return None
+        with self._lock:
+            path = self.checkpoint_path(step)
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+            os.close(fd)
+            np.savez(tmp, **arrays)
+            # np.savez appends .npz to names lacking it
+            tmp_npz = tmp if tmp.endswith(".npz") else tmp + ".npz"
+            os.replace(tmp_npz, path)
+            if tmp != tmp_npz and os.path.exists(tmp):
+                os.remove(tmp)
+
+            st = self._state()
+            base = os.path.basename(path)
+            now = time.time()
+            if (self.keep_every_n_hours > 0 and
+                    now - self._last_kept_forever
+                    >= self.keep_every_n_hours * 3600):
+                st.setdefault("kept_forever", []).append(base)
+                self._last_kept_forever = now
+            else:
+                st["all_model_checkpoint_paths"].append(base)
+            st["latest"] = base
+            # ring rotation (max_to_keep, saver.py:448 parity)
+            while len(st["all_model_checkpoint_paths"]) > self.max_to_keep:
+                victim = st["all_model_checkpoint_paths"].pop(0)
+                vp = os.path.join(self.directory, victim)
+                if os.path.exists(vp):
+                    os.remove(vp)
+            self._write_state(st)
+            return path
+
+    def restore(self, template: PyTree, step: int | None = None) -> PyTree:
+        """Load ``step`` (default: latest) into the template's structure &
+        shardings. Raises FileNotFoundError when nothing exists."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self.directory!r}")
+        path = self.checkpoint_path(step)
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+        return _unflatten(template, arrays)
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    """Path of the newest checkpoint (tf.train.latest_checkpoint parity)."""
+    mgr = CheckpointManager(directory)
+    step = mgr.latest_step()
+    return mgr.checkpoint_path(step) if step is not None else None
+
+
+def restore_or_init(manager: CheckpointManager | None, init_fn,
+                    *args, **kwargs):
+    """The prepare_session decision (session_manager.py:320-335 parity):
+    restore the latest checkpoint when one exists, else run ``init_fn``.
+
+    Returns ``(state, restored: bool)``.
+    """
+    if manager is not None and manager.latest_step() is not None:
+        template = init_fn(*args, **kwargs)
+        return manager.restore(template), True
+    return init_fn(*args, **kwargs), False

@@ -1,0 +1,135 @@
+"""Cluster topology: ``tf.train.ClusterSpec`` parity mapped onto TPU slices.
+
+The reference builds a ``ClusterSpec({"ps": [...], "worker": [...]})`` and a
+``tf.train.Server(cluster, job_name, task_index)`` per process, then the ps
+branch blocks in ``server.join()`` (SURVEY.md §3.1; reference-stack citations
+server_lib.py:242-492 and :94-239). On a TPU pod there is no parameter
+server: every process drives its local chips and parameters live sharded or
+replicated on device, so this module keeps the *configuration surface* while
+translating it to JAX process coordinates:
+
+- ``worker`` task ``i``  →  JAX process index ``i`` (``jax.process_index()``).
+- ``ps`` tasks           →  deleted. ``resolve_legacy_role`` tells callers to
+  exit cleanly with a notice so old multi-process launch scripts still work
+  (SURVEY.md §7 'hard parts' item 3).
+- The worker host list's *order* defines process indices, exactly as task
+  order did in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+PS_JOB = "ps"
+WORKER_JOB = "worker"
+
+
+class ClusterSpec:
+    """A jobs→tasks→address map with the same access surface as
+    ``tf.train.ClusterSpec`` (reference stack server_lib.py:242-492).
+
+    Accepts ``{"job": ["host:port", ...]}`` or ``{"job": {index: addr}}``.
+    """
+
+    def __init__(self, cluster: "Mapping[str, Sequence[str] | Mapping[int, str]] | ClusterSpec"):
+        if isinstance(cluster, ClusterSpec):
+            self._jobs = {j: dict(t) for j, t in cluster._jobs.items()}
+            return
+        self._jobs: dict[str, dict[int, str]] = {}
+        for job, tasks in dict(cluster).items():
+            if isinstance(tasks, Mapping):
+                self._jobs[job] = {int(i): str(a) for i, a in tasks.items()}
+            else:
+                self._jobs[job] = {i: str(a) for i, a in enumerate(tasks)}
+
+    # -- tf.train.ClusterSpec-compatible surface --------------------------
+    @property
+    def jobs(self) -> list[str]:
+        return sorted(self._jobs)
+
+    def num_tasks(self, job_name: str) -> int:
+        return len(self._jobs[job_name])
+
+    def task_indices(self, job_name: str) -> list[int]:
+        return sorted(self._jobs[job_name])
+
+    def task_address(self, job_name: str, task_index: int) -> str:
+        return self._jobs[job_name][task_index]
+
+    def job_tasks(self, job_name: str) -> list[str]:
+        tasks = self._jobs.get(job_name, {})
+        return [tasks[i] for i in sorted(tasks)]
+
+    def as_dict(self) -> dict[str, list[str]]:
+        return {j: self.job_tasks(j) for j in self.jobs}
+
+    def __bool__(self) -> bool:
+        return bool(self._jobs)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, ClusterSpec) and self.as_dict() == other.as_dict()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"ClusterSpec({self.as_dict()!r})"
+
+    # -- TPU mapping ------------------------------------------------------
+    @property
+    def num_workers(self) -> int:
+        return self.num_tasks(WORKER_JOB) if WORKER_JOB in self._jobs else 1
+
+    @property
+    def num_ps(self) -> int:
+        return self.num_tasks(PS_JOB) if PS_JOB in self._jobs else 0
+
+    def coordinator_address(self) -> str | None:
+        """Address used for ``jax.distributed.initialize``: worker task 0.
+
+        The reference used every server's own gRPC port; JAX needs one
+        coordination-service endpoint, for which worker 0 (the chief,
+        SURVEY.md §3.2) is the natural choice.
+        """
+        workers = self.job_tasks(WORKER_JOB) if WORKER_JOB in self._jobs else []
+        return workers[0] if workers else None
+
+
+@dataclasses.dataclass(frozen=True)
+class LegacyRole:
+    """Resolution of a legacy ``--job_name/--task_index`` pair on TPU."""
+
+    job_name: str
+    task_index: int
+    is_chief: bool          # worker task 0, as in the reference (SURVEY.md §3.2)
+    should_run: bool        # False for ps: exit 0 with notice
+    process_index: int      # JAX process index this task maps to
+    num_processes: int
+    notice: str | None = None
+
+
+def resolve_legacy_role(cluster: ClusterSpec | None,
+                        job_name: str = WORKER_JOB,
+                        task_index: int = 0) -> LegacyRole:
+    """Map the reference CLI onto TPU slice coordinates (BASELINE.json:5).
+
+    ``ps`` tasks get ``should_run=False``: on TPU, parameters live on device
+    and gradient aggregation is an XLA all-reduce over ICI, so the PS process
+    has no work; returning cleanly keeps old launch scripts green.
+    """
+    if job_name == PS_JOB:
+        return LegacyRole(
+            job_name=job_name, task_index=task_index, is_chief=False,
+            should_run=False, process_index=0,
+            num_processes=(cluster.num_workers if cluster else 1),
+            notice=(
+                "No PS role on TPU: parameters are device-resident and "
+                "gradient aggregation rides XLA all-reduce over ICI. "
+                f"ps task {task_index} exiting 0 (parity behavior)."),
+        )
+    num = cluster.num_workers if cluster else 1
+    if task_index >= num:
+        raise ValueError(
+            f"task_index {task_index} out of range for {num} worker tasks")
+    return LegacyRole(
+        job_name=job_name, task_index=task_index,
+        is_chief=(task_index == 0), should_run=True,
+        process_index=task_index, num_processes=num)

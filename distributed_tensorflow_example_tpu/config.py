@@ -1,0 +1,163 @@
+"""Config system: dataclass configs + a flag surface preserving the reference CLI.
+
+The reference configured everything through ``tf.app.flags`` (absl) plus a
+``ConfigProto`` (SURVEY.md §5.6). Here the runtime knobs live in plain
+dataclasses (no proto dependency), and :func:`add_legacy_flags` /
+:func:`cluster_from_flags` reproduce the reference's exact CLI surface
+(``--ps_hosts --worker_hosts --job_name --task_index``, SURVEY.md §2.1) on top
+of ``argparse`` so existing launch scripts keep working.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Input pipeline configuration (SURVEY.md §2.1 'Input pipeline')."""
+
+    dataset: str = "mnist"          # mnist | cifar10 | imagenet | bert
+    data_dir: str | None = None     # directory with real files; None => synthetic
+    batch_size: int = 128           # GLOBAL batch size (split over the data axis)
+    shuffle: bool = True
+    seed: int = 0
+    synthetic: bool = False         # force synthetic data even if data_dir set
+    prefetch: int = 2               # host-side prefetch depth
+    # BERT-only knobs
+    seq_len: int = 128
+    vocab_size: int = 30522
+    mlm_mask_prob: float = 0.15
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Base-optimizer knobs (reference: GradientDescent under
+    SyncReplicasOptimizer, SURVEY.md §2.1)."""
+
+    name: str = "sgd"               # sgd | momentum | adam | adamw
+    learning_rate: float = 0.5
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    warmup_steps: int = 0
+    decay_schedule: str = "constant"  # constant | cosine | linear
+    total_steps: int = 0            # for schedules; 0 => constant
+    grad_clip_norm: float = 0.0     # 0 disables
+
+
+@dataclasses.dataclass
+class SyncConfig:
+    """Sync-replica semantics — the SyncReplicasOptimizer surface
+    (sync_replicas_optimizer.py:142 in the reference stack, per SURVEY.md).
+
+    On TPU the barrier/token protocol is implicit in the single compiled
+    step; ``replicas_to_aggregate`` maps onto the size of the data axis and
+    ``accum_steps`` provides accumulate-N-then-apply within a replica
+    (microbatching), which is the closest TPU-native analogue of gradient
+    accumulation on the PS.
+    """
+
+    replicas_to_aggregate: int | None = None  # None => data-axis size
+    total_num_replicas: int | None = None     # backup replicas have no TPU analogue
+    accum_steps: int = 1                      # microbatch accumulation inside the step
+    mode: str = "auto"                        # auto (jit+sharding) | shard_map (explicit psum)
+
+
+@dataclasses.dataclass
+class MeshShape:
+    """Logical mesh axis sizes. Total must equal the device count in use.
+
+    data: pure data parallel; fsdp: data parallel with sharded params/opt
+    state (ZeRO-ish); model: tensor parallel; seq: sequence/context parallel
+    (ring attention); expert: MoE expert parallel; pipe: pipeline stages.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    def total(self) -> int:
+        return (self.data * self.fsdp * self.model * self.seq *
+                self.expert * self.pipe)
+
+    def as_dict(self) -> dict[str, int]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Saver parity (SURVEY.md §3.4/§5.4): chief-writes, max_to_keep ring,
+    'checkpoint' state file, restore-or-init."""
+
+    directory: str | None = None
+    max_to_keep: int = 5
+    save_steps: int = 0             # save every N steps (0 disables step-based)
+    save_secs: float = 0.0          # save every T seconds (0 disables time-based)
+    keep_checkpoint_every_n_hours: float = 0.0
+    async_save: bool = False
+
+
+@dataclasses.dataclass
+class ObservabilityConfig:
+    """Metrics/logging parity (SURVEY.md §5.1/§5.5)."""
+
+    log_every_steps: int = 100
+    metrics_path: str | None = None   # JSONL sink; None => stdout only
+    profile_steps: tuple[int, int] | None = None  # (start, stop) step range
+    profile_dir: str | None = None
+    check_nans: bool = False          # NanTensorHook analogue
+    summary_every_steps: int = 0      # scalar summary cadence (0 disables)
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Top-level config for a training run."""
+
+    model: str = "mlp"
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    sync: SyncConfig = dataclasses.field(default_factory=SyncConfig)
+    mesh: MeshShape = dataclasses.field(default_factory=MeshShape)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    obs: ObservabilityConfig = dataclasses.field(default_factory=ObservabilityConfig)
+    train_steps: int = 1000
+    eval_every_steps: int = 0        # 0 => eval only at the end
+    seed: int = 0
+    dtype: str = "float32"           # compute dtype: float32 | bfloat16
+    param_dtype: str = "float32"
+
+    def replace(self, **kw: Any) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Legacy CLI surface (reference parity)
+# ---------------------------------------------------------------------------
+
+def add_legacy_flags(parser: argparse.ArgumentParser) -> None:
+    """Install the reference's exact distributed flags (SURVEY.md §2.1).
+
+    ``--ps_hosts``/``--worker_hosts`` are comma-separated host:port lists;
+    ``--job_name`` is ``ps`` or ``worker``; ``--task_index`` the task id.
+    On TPU the PS role does not exist — see
+    :func:`distributed_tensorflow_example_tpu.cluster.resolve_legacy_role`.
+    """
+    parser.add_argument("--ps_hosts", type=str, default="",
+                        help="comma-separated ps host:port list (legacy; no "
+                             "PS role on TPU — accepted and mapped away)")
+    parser.add_argument("--worker_hosts", type=str, default="",
+                        help="comma-separated worker host:port list (legacy)")
+    parser.add_argument("--job_name", type=str, default="worker",
+                        choices=["ps", "worker"],
+                        help="legacy job name; 'ps' exits 0 with a notice")
+    parser.add_argument("--task_index", type=int, default=0,
+                        help="legacy task index; maps to the JAX process index")
+
+
+def parse_hosts(csv: str) -> list[str]:
+    return [h.strip() for h in csv.split(",") if h.strip()]

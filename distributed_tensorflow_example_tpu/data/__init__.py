@@ -1,0 +1,19 @@
+"""Input pipeline.
+
+The reference fed MNIST through the legacy ``input_data.read_data_sets`` +
+``mnist.train.next_batch`` feed_dict path (SURVEY.md §2.1 'Input pipeline').
+Here:
+
+- format parsers are real (IDX/CIFAR binary), pure numpy, no TF dependency;
+- when no data directory is available (this sandbox has zero egress) each
+  dataset has a *learnable* synthetic generator with the exact real shapes,
+  so end-to-end training/accuracy tests remain meaningful;
+- :class:`~.loader.ShardedLoader` does seeded shuffling, per-process
+  sharding, and host-side prefetch.
+"""
+
+from .loader import Batch, ShardedLoader, make_loader
+from .mnist import load_mnist, synthetic_mnist
+
+__all__ = ["Batch", "ShardedLoader", "make_loader", "load_mnist",
+           "synthetic_mnist"]

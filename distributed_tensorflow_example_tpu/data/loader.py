@@ -1,0 +1,128 @@
+"""ShardedLoader: seeded shuffling, per-process sharding, prefetch.
+
+Replaces the reference's ``mnist.train.next_batch`` feed_dict loop and the
+era's queue-runner input machinery (SURVEY.md §2.1-2.2 'Legacy queue
+input'): instead of queue threads feeding a graph, the loader yields numpy
+batches that the trainer places onto the mesh with a NamedSharding.
+
+Determinism contract (SURVEY.md §7 hard-parts item 2): with the same seed,
+the *global* batch sequence is identical regardless of process count — each
+process materializes its contiguous slice of the global batch — which is
+what makes N-chip sync training bit-comparable to 1-chip big-batch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+Batch = dict[str, np.ndarray]
+
+
+class ShardedLoader:
+    """Iterates (x, y, ...) arrays as per-process batch dicts.
+
+    Args:
+      arrays: dict of equal-length numpy arrays (leading dim = examples).
+      global_batch: total batch size across all processes.
+      process_index/num_processes: this host's shard of each global batch.
+      shuffle: reshuffle each epoch with a seed derived from (seed, epoch) —
+        identical on every process, as the reference's identical graph-side
+        shuffling was.
+      drop_remainder: keep batches full (static shapes for jit).
+    """
+
+    def __init__(self, arrays: Batch, global_batch: int, *,
+                 process_index: int = 0, num_processes: int = 1,
+                 shuffle: bool = True, seed: int = 0,
+                 drop_remainder: bool = True):
+        if global_batch % num_processes:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{num_processes} processes")
+        self.arrays = arrays
+        self.keys = sorted(arrays)
+        self.n = len(arrays[self.keys[0]])
+        for k in self.keys:
+            if len(arrays[k]) != self.n:
+                raise ValueError("array length mismatch")
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_processes
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_remainder = drop_remainder
+        self.epoch = 0
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return (self.n // self.global_batch if self.drop_remainder
+                else -(-self.n // self.global_batch))
+
+    def epoch_batches(self, epoch: int | None = None) -> Iterator[Batch]:
+        """One epoch of per-process batches."""
+        epoch = self.epoch if epoch is None else epoch
+        idx = np.arange(self.n)
+        if self.shuffle:
+            np.random.RandomState((self.seed, epoch)).shuffle(idx)
+        nb = self.steps_per_epoch
+        for b in range(nb):
+            g0 = b * self.global_batch
+            gidx = idx[g0:g0 + self.global_batch]
+            if len(gidx) < self.global_batch and self.drop_remainder:
+                return
+            # this process's contiguous slice of the global batch
+            l0 = self.process_index * self.local_batch
+            lidx = gidx[l0:l0 + self.local_batch]
+            yield {k: self.arrays[k][lidx] for k in self.keys}
+
+    def __iter__(self) -> Iterator[Batch]:
+        """Endless batches, advancing epochs (next_batch parity)."""
+        while True:
+            yield from self.epoch_batches(self.epoch)
+            self.epoch += 1
+
+
+class PrefetchIterator:
+    """Host-side background prefetch — the queue-runner thread reborn
+    (SURVEY.md §2.2 Coordinator/QueueRunner) as a bounded queue between the
+    loader thread and the device feed."""
+
+    def __init__(self, it: Iterator[Any], depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._it = it
+        self._done = object()
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            for item in self._it:
+                self._q.put(item)
+        except BaseException as e:   # propagate like Coordinator did
+            self._err = e
+        finally:
+            self._q.put(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._done:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
+                **kw) -> Iterator[Batch]:
+    loader = ShardedLoader(arrays, global_batch, **kw)
+    it = iter(loader)
+    return PrefetchIterator(it, prefetch) if prefetch > 0 else it

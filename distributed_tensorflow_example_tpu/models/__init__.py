@@ -1,0 +1,9 @@
+"""Model zoo, in the order of the reference's workload configs
+(BASELINE.json:7-11): MNIST MLP, MNIST LeNet CNN, CIFAR ResNet-20,
+ImageNet ResNet-50, BERT-base MLM.
+"""
+
+from .base import Model, get_model, register_model
+from . import mlp as mlp  # registers "mlp"
+
+__all__ = ["Model", "get_model", "register_model"]

@@ -1,0 +1,66 @@
+"""Model protocol + registry.
+
+A model is a small object exposing:
+
+- ``init(rng) -> params`` or ``(params, extras)``
+- ``apply(params, extras, batch, rng, train) -> (logits, new_extras)``
+- ``loss(params, extras, batch, rng) -> (loss, (aux, new_extras))`` — the
+  framework-canonical training loss (see
+  :mod:`~distributed_tensorflow_example_tpu.parallel.sync_replicas`)
+- ``eval_metrics(params, extras, batch) -> dict`` — forward-only metrics
+- ``sharding_rules(mesh_shape) -> ShardingRules`` — per-model placement
+  (tensor-parallel specs etc.); the default replicates/fsdp-shards.
+- ``dummy_batch(batch_size) -> batch`` — shape-correct synthetic batch for
+  compile checks and benchmarks.
+
+The registry replaces the reference's implicit "one script per model"
+arrangement with ``--model`` selection from a single CLI (SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol
+
+import jax
+
+from ..config import TrainConfig
+from ..parallel.sharding import ShardingRules
+
+
+class Model(Protocol):
+    name: str
+
+    def init(self, rng: jax.Array): ...
+    def apply(self, params, extras, batch, rng, train: bool): ...
+    def loss(self, params, extras, batch, rng): ...
+    def eval_metrics(self, params, extras, batch) -> dict: ...
+    def sharding_rules(self, mesh_shape) -> ShardingRules: ...
+    def dummy_batch(self, batch_size: int): ...
+
+
+_REGISTRY: dict[str, Callable[[TrainConfig], Any]] = {}
+
+
+def register_model(name: str):
+    def deco(factory):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def get_model(name: str, config: TrainConfig | None = None):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](config or TrainConfig(model=name))
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+class DefaultRulesMixin:
+    """Default placement: replicate, fsdp-shard big params when fsdp>1."""
+
+    def sharding_rules(self, mesh_shape) -> ShardingRules:
+        fsdp = getattr(mesh_shape, "fsdp", 1) if mesh_shape else 1
+        return ShardingRules(fsdp_axis_size=fsdp)

@@ -1,0 +1,66 @@
+"""MNIST 2-layer MLP — the reference's parity model.
+
+Reference: 784→hidden→10 with truncated-normal init, softmax cross-entropy,
+plain SGD under SyncReplicasOptimizer (SURVEY.md §2.1 'Model: MNIST 2-layer
+MLP'; BASELINE.json:7 'MNIST 2-layer MLP, 1 PS + 1 worker'). The classic
+script used hidden=100 and lr=0.5-ish; both are config knobs here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TrainConfig
+from ..ops import losses, nn
+from .base import DefaultRulesMixin, register_model
+
+
+class MLP(DefaultRulesMixin):
+    name = "mlp"
+
+    def __init__(self, in_dim: int = 784, hidden: int = 100,
+                 num_classes: int = 10, dtype=jnp.float32):
+        self.in_dim, self.hidden, self.num_classes = in_dim, hidden, num_classes
+        self.dtype = dtype
+
+    def init(self, rng: jax.Array):
+        r1, r2 = jax.random.split(rng)
+        return {
+            "fc1": nn.dense_init(r1, self.in_dim, self.hidden),
+            "fc2": nn.dense_init(r2, self.hidden, self.num_classes),
+        }
+
+    def apply(self, params, extras, batch, rng=None, train: bool = False):
+        x = batch["x"].reshape((batch["x"].shape[0], -1))
+        h = jax.nn.relu(nn.dense(params["fc1"], x, dtype=self.dtype))
+        logits = nn.dense(params["fc2"], h, dtype=self.dtype)
+        return logits, extras
+
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng, train=True)
+        loss = losses.softmax_xent_int_labels(logits, batch["y"])
+        aux = {"accuracy": losses.accuracy(logits, batch["y"])}
+        return loss, (aux, new_extras)
+
+    def eval_metrics(self, params, extras, batch) -> dict:
+        logits, _ = self.apply(params, extras, batch, train=False)
+        return {
+            "loss": losses.softmax_xent_int_labels(logits, batch["y"]),
+            "accuracy": losses.accuracy(logits, batch["y"]),
+        }
+
+    def dummy_batch(self, batch_size: int):
+        rs = np.random.RandomState(0)
+        return {
+            "x": rs.rand(batch_size, self.in_dim).astype(np.float32),
+            "y": rs.randint(0, self.num_classes, size=(batch_size,),
+                            dtype=np.int32),
+        }
+
+
+@register_model("mlp")
+def _make_mlp(config: TrainConfig) -> MLP:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    return MLP(dtype=dtype)

@@ -1,0 +1,37 @@
+"""Core neural-net ops: pure-JAX functional primitives.
+
+Replaces the reference's op layer (``tf.matmul`` / ``tf.nn.*`` under graph
+mode, SURVEY.md §2.1): every op here is a pure function over explicit param
+pytrees, traced once under jit and fused by XLA onto the MXU. Hot-path
+kernels that benefit from manual scheduling live in :mod:`.pallas`.
+"""
+
+from .nn import (
+    conv2d,
+    conv2d_init,
+    dense,
+    dense_init,
+    dropout,
+    embedding,
+    embedding_init,
+    layernorm,
+    layernorm_init,
+    batchnorm,
+    batchnorm_init,
+    max_pool,
+    avg_pool,
+)
+from .losses import (
+    l2_regularization,
+    sigmoid_xent,
+    softmax_xent,
+    softmax_xent_int_labels,
+)
+
+__all__ = [
+    "dense", "dense_init", "conv2d", "conv2d_init", "dropout",
+    "embedding", "embedding_init", "layernorm", "layernorm_init",
+    "batchnorm", "batchnorm_init", "max_pool", "avg_pool",
+    "softmax_xent", "softmax_xent_int_labels", "sigmoid_xent",
+    "l2_regularization",
+]

@@ -1,0 +1,51 @@
+"""Loss functions.
+
+The reference used graph-mode softmax cross-entropy over one-hot MNIST
+labels (SURVEY.md §2.1 'Model' row). All losses here reduce with a *mean*
+over the batch so that, under data sharding, the gradient all-reduce is a
+mean — matching the reference's explicit gradient averaging
+(sync_replicas_optimizer.py:36-40 note; SURVEY.md §7 hard-parts item 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_xent(logits: jax.Array, onehot: jax.Array,
+                 *, where=None) -> jax.Array:
+    """Mean softmax cross-entropy against one-hot (or soft) targets."""
+    logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    ll = jnp.sum(onehot * (logits - logz), axis=-1)
+    if where is not None:
+        return -jnp.sum(ll * where) / jnp.maximum(jnp.sum(where), 1.0)
+    return -jnp.mean(ll)
+
+
+def softmax_xent_int_labels(logits: jax.Array, labels: jax.Array,
+                            *, where=None) -> jax.Array:
+    """Mean softmax cross-entropy against integer labels (gather form —
+    avoids materializing one-hots for big vocabularies like BERT's)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1).squeeze(-1) - logz
+    if where is not None:
+        return -jnp.sum(ll * where) / jnp.maximum(jnp.sum(where), 1.0)
+    return -jnp.mean(ll)
+
+
+def sigmoid_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    return -jnp.mean(labels * log_p + (1.0 - labels) * log_not_p)
+
+
+def l2_regularization(params, scale: float) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(params)
+    return scale * sum(jnp.sum(jnp.square(x)) for x in leaves)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """labels: integer classes. Returns mean accuracy (f32 scalar)."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

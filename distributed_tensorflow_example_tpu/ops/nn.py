@@ -1,0 +1,195 @@
+"""Functional NN primitives with explicit parameter pytrees.
+
+Initializers return plain dicts; apply functions are pure. Convention:
+matmul-bearing ops accept a ``dtype`` compute dtype (bfloat16 on TPU keeps
+the MXU fed at full rate) while params stay in ``param_dtype`` (float32 by
+default) — the standard mixed-precision recipe.
+
+Reference parity: the MLP used ``tf.Variable`` weight/bias pairs with
+truncated-normal init and ``tf.matmul`` (SURVEY.md §2.1 'Model' row).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# initializer helpers
+# ---------------------------------------------------------------------------
+
+def _truncated_normal(rng, shape, stddev, dtype):
+    # match the classic tf.truncated_normal(stddev=1/sqrt(fan_in)) init of
+    # the reference MLP: resample beyond 2 sigma ≈ truncate
+    u = jax.random.truncated_normal(rng, -2.0, 2.0, shape, jnp.float32)
+    return (u * stddev).astype(dtype)
+
+
+def glorot_uniform(rng, shape, dtype, fan_in, fan_out):
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+def he_normal(rng, shape, dtype, fan_in):
+    std = math.sqrt(2.0 / fan_in)
+    return (jax.random.normal(rng, shape, jnp.float32) * std).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# dense
+# ---------------------------------------------------------------------------
+
+def dense_init(rng, in_dim: int, out_dim: int, *,
+               init: str = "truncated_normal",
+               param_dtype=jnp.float32) -> Params:
+    krng, _ = jax.random.split(rng)
+    if init == "truncated_normal":
+        kernel = _truncated_normal(krng, (in_dim, out_dim),
+                                   1.0 / math.sqrt(in_dim), param_dtype)
+    elif init == "glorot":
+        kernel = glorot_uniform(krng, (in_dim, out_dim), param_dtype,
+                                in_dim, out_dim)
+    elif init == "he":
+        kernel = he_normal(krng, (in_dim, out_dim), param_dtype, in_dim)
+    else:
+        raise ValueError(f"unknown init {init!r}")
+    return {"kernel": kernel, "bias": jnp.zeros((out_dim,), param_dtype)}
+
+
+def dense(params: Params, x: jax.Array, *, dtype=None) -> jax.Array:
+    """y = x @ W + b. With ``dtype=bfloat16`` the matmul runs on the MXU in
+    bf16 with f32 accumulation (preferred_element_type)."""
+    kernel, bias = params["kernel"], params["bias"]
+    if dtype is not None:
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+    y = jax.lax.dot_general(x, kernel,
+                            (((x.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y + bias.astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# conv / pooling
+# ---------------------------------------------------------------------------
+
+def conv2d_init(rng, kh: int, kw: int, in_ch: int, out_ch: int, *,
+                use_bias: bool = True,
+                param_dtype=jnp.float32) -> Params:
+    kernel = he_normal(rng, (kh, kw, in_ch, out_ch), param_dtype,
+                       fan_in=kh * kw * in_ch)
+    p: Params = {"kernel": kernel}
+    if use_bias:
+        p["bias"] = jnp.zeros((out_ch,), param_dtype)
+    return p
+
+
+def conv2d(params: Params, x: jax.Array, *, stride: int = 1,
+           padding: str = "SAME", dtype=None) -> jax.Array:
+    """NHWC conv, HWIO kernel — XLA's native TPU conv layout."""
+    kernel = params["kernel"]
+    if dtype is not None:
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+    y = lax.conv_general_dilated(
+        x, kernel, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    if "bias" in params:
+        y = y + params["bias"].astype(y.dtype)
+    return y
+
+
+def max_pool(x: jax.Array, window: int = 2, stride: int = 2,
+             padding: str = "VALID") -> jax.Array:
+    return lax.reduce_window(x, -jnp.inf, lax.max,
+                             (1, window, window, 1), (1, stride, stride, 1),
+                             padding)
+
+
+def avg_pool(x: jax.Array, window: int = 2, stride: int = 2,
+             padding: str = "VALID") -> jax.Array:
+    s = lax.reduce_window(x, 0.0, lax.add,
+                          (1, window, window, 1), (1, stride, stride, 1),
+                          padding)
+    return s / (window * window)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def layernorm_init(dim: int, *, param_dtype=jnp.float32) -> Params:
+    return {"scale": jnp.ones((dim,), param_dtype),
+            "bias": jnp.zeros((dim,), param_dtype)}
+
+
+def layernorm(params: Params, x: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var + eps)
+    return y * params["scale"] + params["bias"]
+
+
+def batchnorm_init(dim: int, *, param_dtype=jnp.float32
+                   ) -> tuple[Params, Params]:
+    """Returns (params, extras): scale/bias are trained; running mean/var
+    live in TrainState.extras (non-trained state, SURVEY.md parity with
+    non-trainable PS Variables)."""
+    params = {"scale": jnp.ones((dim,), param_dtype),
+              "bias": jnp.zeros((dim,), param_dtype)}
+    extras = {"mean": jnp.zeros((dim,), jnp.float32),
+              "var": jnp.ones((dim,), jnp.float32)}
+    return params, extras
+
+
+def batchnorm(params: Params, extras: Params, x: jax.Array, *,
+              train: bool, momentum: float = 0.9, eps: float = 1e-5
+              ) -> tuple[jax.Array, Params]:
+    """BatchNorm over N,H,W (all but last). In the auto sync mode the batch
+    dim is globally sharded, so these are global-batch statistics (sync-BN).
+    Returns (y, new_extras)."""
+    if train:
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        new_extras = {
+            "mean": momentum * extras["mean"] + (1 - momentum) * mean,
+            "var": momentum * extras["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = extras["mean"], extras["var"]
+        new_extras = extras
+    y = (x - mean) * lax.rsqrt(var + eps)
+    return y * params["scale"] + params["bias"], new_extras
+
+
+# ---------------------------------------------------------------------------
+# embedding / dropout
+# ---------------------------------------------------------------------------
+
+def embedding_init(rng, vocab: int, dim: int, *,
+                   param_dtype=jnp.float32) -> Params:
+    table = (jax.random.normal(rng, (vocab, dim), jnp.float32)
+             * 0.02).astype(param_dtype)
+    return {"table": table}
+
+
+def embedding(params: Params, ids: jax.Array) -> jax.Array:
+    return jnp.take(params["table"], ids, axis=0)
+
+
+def dropout(rng: jax.Array, x: jax.Array, rate: float,
+            *, train: bool) -> jax.Array:
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)

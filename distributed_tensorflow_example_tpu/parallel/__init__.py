@@ -1,0 +1,37 @@
+"""Parallelism layer: mesh, collectives, sharding rules, sync-replica step.
+
+This package replaces the reference's entire distribution machinery
+(SURVEY.md §2.2-2.5): SyncReplicasOptimizer, replica_device_setter, and the
+C++ rendezvous/gRPC transfer path all collapse into NamedSharding rules over
+a device mesh plus XLA collectives compiled into one train step.
+"""
+
+from .mesh import AxisNames, MeshConfig, build_mesh, local_mesh
+from .collectives import (
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    all_to_all,
+    ppermute_ring_shift,
+    reduce_scatter_mean,
+)
+from .sharding import (
+    ShardingRules,
+    batch_pspec,
+    batch_sharding,
+    named_sharding,
+    replica_device_setter,
+    shard_batch,
+    shard_params,
+    state_shardings,
+)
+from .sync_replicas import SyncReplicas, make_sync_train_step
+
+__all__ = [
+    "AxisNames", "MeshConfig", "build_mesh", "local_mesh",
+    "all_gather", "all_reduce_mean", "all_reduce_sum", "all_to_all",
+    "ppermute_ring_shift", "reduce_scatter_mean",
+    "ShardingRules", "batch_pspec", "batch_sharding", "named_sharding",
+    "replica_device_setter", "shard_batch", "shard_params", "state_shardings",
+    "SyncReplicas", "make_sync_train_step",
+]

@@ -1,0 +1,127 @@
+"""Device mesh construction.
+
+The reference's topology was a flat jobs→tasks address map (ClusterSpec,
+SURVEY.md §2.2); its only 'mesh' was the worker list, and parameter layout
+was round-robin over PS tasks. TPU-native topology is a logical
+``jax.sharding.Mesh`` whose axes name the parallelism dimensions. We fix the
+axis vocabulary once, framework-wide:
+
+========  =======================================================
+axis      meaning
+========  =======================================================
+data      pure data parallelism (sync replicas, SURVEY.md §2.5)
+fsdp      data parallelism with sharded params/optimizer state
+model     tensor parallelism (activations/weights split)
+seq       sequence/context parallelism (ring attention)
+expert    MoE expert parallelism
+pipe      pipeline-parallel stages
+========  =======================================================
+
+Only ``data`` is required for reference parity; the rest exist so every
+model and step function in the framework is written against the full axis
+set from day one and scaling is a config change, not a rewrite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from ..config import MeshShape
+
+
+class AxisNames:
+    DATA = "data"
+    FSDP = "fsdp"
+    MODEL = "model"
+    SEQ = "seq"
+    EXPERT = "expert"
+    PIPE = "pipe"
+
+    ALL: tuple[str, ...] = ("data", "fsdp", "model", "seq", "expert", "pipe")
+    # Axes over which gradients are averaged (batch is split over these).
+    BATCH: tuple[str, ...] = ("data", "fsdp")
+
+
+# MeshConfig is the user-facing alias for the axis-size dataclass.
+MeshConfig = MeshShape
+
+
+def build_mesh(shape: MeshShape | dict | None = None,
+               devices: Sequence[jax.Device] | None = None,
+               *,
+               backend: str | None = None) -> Mesh:
+    """Build a Mesh with the framework's canonical axis names.
+
+    With ``shape=None``, all devices go on the ``data`` axis — the exact
+    analogue of the reference's N-worker sync data parallelism. Axis sizes
+    of ``-1`` (at most one) absorb the remaining devices.
+
+    On real TPU hardware ``mesh_utils.create_device_mesh`` picks an
+    ICI-friendly device order; for explicit device lists (tests, virtual CPU
+    meshes) a plain reshape is used.
+    """
+    if devices is None:
+        devices = jax.devices(backend) if backend else jax.devices()
+    devices = list(devices)
+    ndev = len(devices)
+
+    if shape is None:
+        shape = MeshShape(data=ndev)
+    elif isinstance(shape, dict):
+        shape = MeshShape(**shape)
+
+    sizes = {a: getattr(shape, a) for a in AxisNames.ALL}
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one -1 axis allowed, got {wild}")
+    if wild:
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if ndev % known:
+            raise ValueError(f"{ndev} devices not divisible by {known}")
+        sizes[wild[0]] = ndev // known
+    total = math.prod(sizes.values())
+    if total != ndev:
+        raise ValueError(
+            f"mesh shape {sizes} wants {total} devices but {ndev} available")
+
+    dims = tuple(sizes[a] for a in AxisNames.ALL)
+    if devices == list(jax.devices()) and len(devices) > 1 and _is_tpu(devices):
+        dmesh = mesh_utils.create_device_mesh(dims, devices=devices)
+    else:
+        dmesh = np.asarray(devices).reshape(dims)
+    return Mesh(dmesh, AxisNames.ALL)
+
+
+def _is_tpu(devices: Sequence[jax.Device]) -> bool:
+    return devices[0].platform == "tpu"
+
+
+def local_mesh(n: int | None = None,
+               shape: MeshShape | dict | None = None) -> Mesh:
+    """A CPU-device mesh for tests (SURVEY.md §4 item 2): the analogue of
+    the reference's in-process ``create_local_cluster`` fixture."""
+    devs = jax.devices("cpu")
+    if n is not None:
+        if len(devs) < n:
+            raise RuntimeError(
+                f"need {n} CPU devices, have {len(devs)}; set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n}")
+        devs = devs[:n]
+    return build_mesh(shape, devices=devs)
+
+
+def mesh_axis_size(mesh: Mesh, *axes: str) -> int:
+    """Product of the given axis sizes (e.g. the sync-replica count =
+    size of the batch axes)."""
+    return math.prod(mesh.shape[a] for a in axes)
+
+
+def batch_axis_size(mesh: Mesh) -> int:
+    return mesh_axis_size(mesh, *AxisNames.BATCH)

@@ -1,0 +1,144 @@
+"""Parameter & batch placement: the ``replica_device_setter`` replacement.
+
+The reference pinned every ``tf.Variable`` to a PS task, round-robin over the
+ps job (device_setter.py:128-223, :32-60 in the reference stack — SURVEY.md
+§2.2), a communication-naive placement that forced two full param-size
+network transfers per step (SURVEY.md §3.3). The TPU-native replacement is
+declarative: each parameter gets a ``PartitionSpec`` over the mesh, chosen by
+path-pattern rules, and XLA materializes whatever collectives that layout
+implies.
+
+Built-in policies:
+
+- **replicated** (default): every chip holds the full params; gradient
+  exchange is one fused all-reduce — the direct sync-DP analogue.
+- **fsdp**: large params sharded over the ``fsdp`` axis (ZeRO-style); the
+  *spiritual* successor of round-robin PS sharding, except shards live on
+  the chips doing the compute and move over ICI.
+- **rules**: explicit per-path PartitionSpecs for tensor/expert parallelism
+  (models attach these; see ``models/bert.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.pytree import path_str as _path_str
+from .mesh import AxisNames
+
+PyTree = Any
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_pspec(leading_extra: int = 0) -> P:
+    """PartitionSpec for batch-leading arrays: batch dim split over the
+    combined (data, fsdp) axes — the sync-replica data split."""
+    return P(*([None] * leading_extra), AxisNames.BATCH)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_pspec())
+
+
+def shard_batch(mesh: Mesh, batch: PyTree) -> PyTree:
+    """Place a host-side batch pytree onto the mesh, split over the batch
+    axes (replaces feed_dict + the implicit host→device copies of
+    Session.run, SURVEY.md §2.3).
+
+    Single-process: the arrays are the global batch; a plain sharded
+    device_put splits them. Multi-process: each host holds only its
+    *local* slice (ShardedLoader's per-process shard), so the global array
+    is assembled from per-process data — the moral opposite of the
+    reference, where the feed_dict was per-worker and the "global batch"
+    never existed anywhere (SURVEY.md §3.3).
+    """
+    sh = batch_sharding(mesh)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sh, x), batch)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) placement rules with an fsdp fallback.
+
+    ``rules`` are tried in order against the parameter's ``/``-joined path;
+    first match wins. Unmatched params follow the fallback policy:
+    replicated, or — when ``fsdp_axis_size > 1`` — sharded over ``fsdp``
+    along the largest evenly-divisible dimension not already taken.
+    """
+
+    rules: Sequence[tuple[str, P]] = ()
+    fsdp_axis_size: int = 1
+    fsdp_min_size: int = 2 ** 12   # don't shard tiny params (biases, norms)
+
+    def spec_for(self, path: str, shape: tuple[int, ...]) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec
+        if self.fsdp_axis_size > 1 and int(np.prod(shape)) >= self.fsdp_min_size:
+            # shard the largest divisible dim over fsdp
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if shape[i] % self.fsdp_axis_size == 0:
+                    spec = [None] * len(shape)
+                    spec[i] = AxisNames.FSDP
+                    return P(*spec)
+        return P()
+
+    def tree_pspecs(self, params: PyTree) -> PyTree:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: self.spec_for(_path_str(path), np.shape(x)), params)
+
+    def tree_shardings(self, mesh: Mesh, params: PyTree) -> PyTree:
+        return jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), self.tree_pspecs(params),
+            is_leaf=lambda x: isinstance(x, P))
+
+
+def replica_device_setter(mesh: Mesh,
+                          rules: ShardingRules | None = None
+                          ) -> Callable[[PyTree], PyTree]:
+    """API-parity wrapper named after the reference's device function
+    (device_setter.py:128-223). Returns ``place(params) -> params`` that
+    lays a parameter pytree out on the mesh per the rules — the modern
+    equivalent of wrapping graph construction in
+    ``tf.device(replica_device_setter(...))`` (SURVEY.md §3.2)."""
+    rules = rules or ShardingRules(fsdp_axis_size=mesh.shape[AxisNames.FSDP])
+
+    def place(params: PyTree) -> PyTree:
+        shardings = rules.tree_shardings(mesh, params)
+        return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+    return place
+
+
+def shard_params(mesh: Mesh, params: PyTree,
+                 rules: ShardingRules | None = None) -> PyTree:
+    return replica_device_setter(mesh, rules)(params)
+
+
+def state_shardings(mesh: Mesh, state: PyTree,
+                    rules: ShardingRules | None = None) -> PyTree:
+    """NamedShardings for a full TrainState pytree: params/opt-state follow
+    the rules (opt-state moments inherit their param's layout when shapes
+    match), scalars (step, rng) are replicated."""
+    rules = rules or ShardingRules(fsdp_axis_size=mesh.shape[AxisNames.FSDP])
+
+    def spec(path, x) -> NamedSharding:
+        shape = np.shape(x)
+        if len(shape) == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, rules.spec_for(_path_str(path), shape))
+
+    return jax.tree_util.tree_map_with_path(spec, state)

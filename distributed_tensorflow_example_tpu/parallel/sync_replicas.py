@@ -1,0 +1,237 @@
+"""Sync data-parallel training: the ``SyncReplicasOptimizer`` replacement.
+
+The reference's sync protocol (sync_replicas_optimizer.py:41-135 in the
+reference stack; SURVEY.md §2.2, §3.3) was: every worker pushes gradients
+into per-variable ConditionalAccumulators on the PS, a chief queue-runner
+thread takes ``replicas_to_aggregate`` gradients, **averages** them, applies
+the update, bumps ``global_step``, and enqueues tokens that each blocked
+worker dequeues as its barrier. Stale gradients are dropped by a
+``local_step`` check.
+
+On TPU that entire protocol — accumulate, average, apply, barrier — is a
+single compiled program: gradients are averaged by one fused XLA all-reduce
+over ICI, the update is computed identically on every chip, and the
+"barrier" is simply that the collective cannot complete until every replica
+arrives. Staleness is impossible (SPMD lockstep), so the ``local_step``
+machinery has no analogue; backup replicas (``total_num_replicas >
+replicas_to_aggregate``) don't exist because ICI topology is fixed —
+documented as intentionally dropped (SURVEY.md §2.5).
+
+Two implementations are provided:
+
+- ``mode="auto"`` (default, fastest): placement-driven. Params are laid out
+  by :mod:`.sharding` rules (replicated or fsdp), the batch is split over
+  the batch axes, and ``jax.jit`` inserts the collectives. This is the
+  idiomatic form and supports every mesh axis (tp/sp/... come from the
+  model's own sharding rules). Normalization statistics taken over the
+  batch dimension become *global*-batch statistics automatically (sync-BN
+  semantics for free).
+- ``mode="shard_map"``: explicit per-replica SPMD with a hand-written
+  ``pmean`` — the literal accumulate/average/apply dataflow, useful for
+  pedagogy and for asserting the auto path's semantics in tests.
+
+``accum_steps > 1`` adds microbatch gradient accumulation via ``lax.scan``
+(accumulate-N-then-apply *within* a replica — the TPU-meaningful residue of
+the PS-side accumulate-N protocol).
+
+The canonical loss signature framework-wide::
+
+    loss_fn(params, extras, batch, rng) -> (loss, (aux_metrics, new_extras))
+
+where ``extras`` is non-trained model state (BatchNorm stats etc.; ``{}``
+when unused) and ``aux_metrics`` is a dict of scalars.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SyncConfig
+from ..train.state import TrainState
+from .mesh import AxisNames, batch_axis_size
+from .sharding import ShardingRules, batch_pspec, state_shardings
+
+# loss_fn(params, extras, batch, rng) -> (loss, (aux_metrics, new_extras))
+LossFn = Callable[[Any, Any, Any, jax.Array], tuple[jax.Array, tuple[dict, Any]]]
+
+
+def _split_microbatches(batch: Any, accum_steps: int) -> Any:
+    """[B, ...] -> [accum, B/accum, ...] on every leaf."""
+    def r(x):
+        b = x.shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"batch dim {b} not divisible by accum_steps={accum_steps}")
+        return x.reshape((accum_steps, b // accum_steps) + x.shape[1:])
+    return jax.tree_util.tree_map(r, batch)
+
+
+def _grads_and_metrics(loss_fn: LossFn, params, extras, batch, rng,
+                       accum_steps: int):
+    """Gradients (+ loss/aux/extras) with optional microbatch accumulation."""
+    vg = jax.value_and_grad(loss_fn, has_aux=True)
+    if accum_steps <= 1:
+        (loss, (aux, new_extras)), grads = vg(params, extras, batch, rng)
+        return grads, loss, aux, new_extras
+
+    micro = _split_microbatches(batch, accum_steps)
+
+    def body(carry, inp):
+        i, mb = inp
+        gsum, lsum, ex = carry
+        # distinct rng per microbatch: otherwise dropout masks repeat and
+        # accumulation no longer approximates the full-batch step
+        (l, (aux, ex)), g = vg(params, ex, mb, jax.random.fold_in(rng, i))
+        gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+        return (gsum, lsum + l, ex), aux
+
+    zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (gsum, lsum, new_extras), auxes = lax.scan(
+        body, (zero_g, jnp.zeros(()), extras),
+        (jnp.arange(accum_steps), micro))
+    grads = jax.tree_util.tree_map(lambda g: g / accum_steps, gsum)
+    # average aux over microbatches so metrics describe the whole batch,
+    # consistent with the loss
+    aux = jax.tree_util.tree_map(lambda a: jnp.mean(a, axis=0), auxes)
+    return grads, lsum / accum_steps, aux, new_extras
+
+
+class SyncReplicas:
+    """Builds the compiled sync train step for a (loss_fn, optimizer, mesh).
+
+    Usage::
+
+        sync = SyncReplicas(loss_fn, tx, mesh)
+        state = sync.init(model_init, seed=0)
+        state, metrics = sync.step(state, sync.shard_batch(batch))
+    """
+
+    def __init__(self,
+                 loss_fn: LossFn,
+                 tx: optax.GradientTransformation,
+                 mesh: Mesh,
+                 *,
+                 sync: SyncConfig | None = None,
+                 rules: ShardingRules | None = None,
+                 donate: bool = True):
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.mesh = mesh
+        self.sync = sync or SyncConfig()
+        self.rules = rules or ShardingRules(
+            fsdp_axis_size=mesh.shape[AxisNames.FSDP])
+        self.num_replicas = batch_axis_size(mesh)
+        if (self.sync.replicas_to_aggregate is not None
+                and self.sync.replicas_to_aggregate != self.num_replicas):
+            raise ValueError(
+                "replicas_to_aggregate must equal the batch-axis size "
+                f"({self.num_replicas}) on TPU: partial aggregation has no "
+                "SPMD analogue (reference backup-replica semantics dropped, "
+                "see module docstring)")
+        if self.sync.mode not in ("auto", "shard_map"):
+            raise ValueError(f"unknown sync mode {self.sync.mode!r}")
+
+        donate_args = (0,) if donate else ()
+        if self.sync.mode == "auto":
+            self.step = jax.jit(self._auto_step, donate_argnums=donate_args)
+        else:
+            self.step = jax.jit(self._shard_map_step,
+                                donate_argnums=donate_args)
+
+    # ---- state / batch placement ---------------------------------------
+    def init(self,
+             init_fn: Callable[[jax.Array], Any],
+             *, seed: int = 0) -> TrainState:
+        """Initialize a sharded TrainState directly on the mesh.
+
+        ``init_fn(rng)`` returns either ``params`` or ``(params, extras)``.
+
+        The chief-initializes-then-workers-wait protocol of the reference
+        (SessionManager.prepare_session / wait_for_session, SURVEY.md §3.2)
+        is unnecessary under SPMD: every process runs the same seeded init
+        program, so all replicas start bit-identical by construction.
+        """
+        rng = jax.random.key(seed)
+        init_rng, state_rng = jax.random.split(rng)
+
+        def build():
+            out = init_fn(init_rng)
+            params, extras = out if isinstance(out, tuple) else (out, {})
+            return TrainState.create(params=params, tx=self.tx,
+                                     extras=extras, rng=state_rng)
+
+        abstract = jax.eval_shape(build)
+        shardings = state_shardings(self.mesh, abstract, self.rules)
+        return jax.jit(build, out_shardings=shardings)()
+
+    def shard_batch(self, batch: Any) -> Any:
+        from .sharding import shard_batch
+        return shard_batch(self.mesh, batch)
+
+    # ---- step implementations ------------------------------------------
+    def _update(self, state: TrainState, grads, loss, aux, new_extras):
+        updates, opt_state = self.tx.update(grads, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state,
+            extras=new_extras,
+            rng=jax.random.fold_in(state.rng, state.step))
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
+                   **aux}
+        return new_state, metrics
+
+    def _auto_step(self, state: TrainState, batch):
+        """Placement-driven: XLA inserts the gradient all-reduce because the
+        loss is a mean over the (data-sharded) global batch while params are
+        replicated/fsdp-sharded. One fused program = SURVEY.md §3.3 steps
+        1-4 plus the chief aggregation loop."""
+        rng = jax.random.fold_in(state.rng, state.step)
+        grads, loss, aux, new_extras = _grads_and_metrics(
+            self.loss_fn, state.params, state.extras, batch, rng,
+            self.sync.accum_steps)
+        return self._update(state, grads, loss, aux, new_extras)
+
+    def _shard_map_step(self, state: TrainState, batch):
+        """Explicit SPMD: per-replica grads then hand-written pmean — the
+        literal accumulate→average→apply→barrier dataflow. Params must be
+        replicated (fsdp/tp rules are the auto path's job)."""
+        axes = AxisNames.BATCH
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(P(), jax.tree_util.tree_map(
+                     lambda _: batch_pspec(), batch)),
+                 out_specs=P(),
+                 check_vma=False)
+        def run(st: TrainState, local_batch):
+            rng = jax.random.fold_in(st.rng, st.step)
+            grads, loss, aux, new_extras = _grads_and_metrics(
+                self.loss_fn, st.params, st.extras, local_batch, rng,
+                self.sync.accum_steps)
+            # the all-reduce: average of per-replica gradient means
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, axes), grads)
+            loss = lax.pmean(loss, axes)
+            aux = jax.tree_util.tree_map(lambda a: lax.pmean(a, axes), aux)
+            new_extras = jax.tree_util.tree_map(
+                lambda e: lax.pmean(e, axes), new_extras)
+            return self._update(st, grads, loss, aux, new_extras)
+
+        return run(state, batch)
+
+
+def make_sync_train_step(loss_fn: LossFn,
+                         tx: optax.GradientTransformation,
+                         mesh: Mesh,
+                         **kwargs) -> SyncReplicas:
+    """Functional alias for ``SyncReplicas(...)`` mirroring the reference's
+    ``opt = SyncReplicasOptimizer(base_opt, ...); train_op = opt.minimize``
+    construction site (SURVEY.md §3.2)."""
+    return SyncReplicas(loss_fn, tx, mesh, **kwargs)

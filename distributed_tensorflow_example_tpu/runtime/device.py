@@ -1,0 +1,51 @@
+"""Device discovery helpers.
+
+The reference enumerated devices implicitly through ClusterSpec task lists;
+here devices come from the JAX runtime. These helpers centralize backend
+selection so tests can force the virtual-CPU path (8 XLA host devices via
+``--xla_force_host_platform_device_count``) while production uses TPU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import jax
+
+
+def available_devices(backend: str | None = None) -> list[jax.Device]:
+    """All addressable devices, preferring the requested backend.
+
+    With ``backend=None``: returns the default backend's devices (TPU when
+    present). Unknown backends fall back to the default rather than raising,
+    so a single code path works on TPU machines and CPU-only CI.
+    """
+    if backend is not None:
+        try:
+            return list(jax.devices(backend))
+        except RuntimeError:
+            pass
+    return list(jax.devices())
+
+
+def cpu_devices(min_count: int = 1) -> list[jax.Device]:
+    """CPU devices for simulated-mesh tests (SURVEY.md §4 item 2).
+
+    Raises with a actionable message when too few virtual devices exist.
+    """
+    devs = jax.devices("cpu")
+    if len(devs) < min_count:
+        raise RuntimeError(
+            f"need >= {min_count} CPU devices but found {len(devs)}; set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{min_count} before importing jax")
+    return list(devs)
+
+
+def default_device_kind() -> str:
+    return jax.devices()[0].device_kind
+
+
+def local_device_count(backend: str | None = None) -> int:
+    return len(available_devices(backend))

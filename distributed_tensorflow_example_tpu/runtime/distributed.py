@@ -1,0 +1,99 @@
+"""Multi-host bring-up: the TPU-native replacement for GrpcServer + Master.
+
+In the reference every process ran an in-process gRPC server hosting
+Master/Worker services, and session bring-up was Supervisor's
+``prepare_or_wait_for_session`` chief/worker split (SURVEY.md §3.1-3.2).
+On TPU the control plane is the TSL coordination service that
+``jax.distributed.initialize`` starts — the literal same C++ service the
+modern reference stack uses for liveness/barriers (SURVEY.md §5.3,
+coordination_service.h:149,:233) — and there is no per-process data-plane
+server at all: tensors move over ICI/DCN inside compiled programs.
+
+Everything here is single-host no-op'able (SURVEY.md §7 'hard parts' item 1)
+so the same trainer runs on one chip or a pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+
+from ..cluster import ClusterSpec, LegacyRole, resolve_legacy_role
+
+log = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedContext:
+    """What a process knows about its place in the cluster after init."""
+
+    process_index: int
+    num_processes: int
+    is_chief: bool                 # process 0, mirroring worker task 0
+    coordinator_address: str | None
+    multihost: bool
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def initialize(cluster: ClusterSpec | None = None,
+               job_name: str = "worker",
+               task_index: int = 0,
+               *,
+               force: bool = False) -> DistributedContext:
+    """Bring up the distributed runtime for this process.
+
+    Single process (no cluster / 1 worker): returns immediately — JAX is
+    already live. Multi-process: calls ``jax.distributed.initialize`` with
+    worker 0 as coordinator, matching the chief role of the reference
+    (SURVEY.md §3.2). Safe to call more than once.
+    """
+    global _INITIALIZED
+    role = resolve_legacy_role(cluster, job_name, task_index)
+    if not role.should_run:
+        # PS role: caller is expected to print role.notice and exit 0.
+        return DistributedContext(
+            process_index=0, num_processes=role.num_processes,
+            is_chief=False, coordinator_address=None, multihost=False)
+
+    coord = cluster.coordinator_address() if cluster else None
+    multihost = role.num_processes > 1
+
+    if multihost and (force or not _INITIALIZED):
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=role.num_processes,
+            process_id=role.process_index,
+        )
+        _INITIALIZED = True
+        log.info("jax.distributed initialized: process %d/%d, coordinator %s",
+                 role.process_index, role.num_processes, coord)
+
+    return DistributedContext(
+        process_index=jax.process_index() if multihost else role.process_index,
+        num_processes=jax.process_count() if multihost else role.num_processes,
+        is_chief=role.is_chief,
+        coordinator_address=coord,
+        multihost=multihost,
+    )
+
+
+def barrier(name: str = "dtx_barrier") -> None:
+    """Cross-process barrier (coordination-service backed).
+
+    Parity with the token-queue barrier of SyncReplicasOptimizer's bring-up
+    and Supervisor's wait-for-session (SURVEY.md §3.2-3.3), but only needed
+    at host-level sync points (checkpoint fences, shutdown); the per-step
+    barrier lives inside the compiled all-reduce.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,74 @@
+"""``tf.train.Server`` parity handle.
+
+The reference's per-process server (server_lib.py:94-239 in the reference
+stack, SURVEY.md §2.2) bound a gRPC port and hosted Master/Worker services;
+``ps`` processes then blocked forever in ``server.join()`` (SURVEY.md §3.1).
+On TPU there is no data-plane server to run, so this class keeps the API
+shape — construction from (cluster, job_name, task_index), ``start``,
+``join``, ``target``, ``create_local_server`` — while delegating the real
+work to :mod:`.distributed`:
+
+- worker tasks: ``start()`` initializes the distributed runtime;
+  ``join()`` returns immediately (workers drive the training loop
+  themselves; there is no service thread to wait on).
+- ps tasks: ``join()`` logs the no-PS-on-TPU notice and returns, so the
+  reference's ``if job_name == "ps": server.join()`` pattern exits cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..cluster import ClusterSpec, resolve_legacy_role
+from . import distributed
+
+log = logging.getLogger(__name__)
+
+
+class Server:
+    """In-process runtime handle with the reference Server's surface."""
+
+    def __init__(self,
+                 cluster: ClusterSpec | dict | None = None,
+                 job_name: str = "worker",
+                 task_index: int = 0,
+                 start: bool = True):
+        self.cluster = ClusterSpec(cluster) if cluster and not isinstance(cluster, ClusterSpec) else cluster
+        self.job_name = job_name
+        self.task_index = task_index
+        self.role = resolve_legacy_role(self.cluster, job_name, task_index)
+        self._context: distributed.DistributedContext | None = None
+        if start:
+            self.start()
+
+    def start(self) -> None:
+        if self._context is None and self.role.should_run:
+            self._context = distributed.initialize(
+                self.cluster, self.job_name, self.task_index)
+
+    @property
+    def context(self) -> distributed.DistributedContext | None:
+        return self._context
+
+    @property
+    def target(self) -> str:
+        """Session-target parity string. The reference returned a
+        ``grpc://host:port`` master address; here the 'master' is the local
+        JAX runtime, identified by process coordinates."""
+        idx = self._context.process_index if self._context else self.role.process_index
+        return f"tpu://process/{idx}"
+
+    def join(self) -> None:
+        """Block like the reference's ps branch — except there is nothing to
+        host, so log the notice and return (clean exit for launch scripts)."""
+        if not self.role.should_run:
+            log.warning(self.role.notice)
+            return
+        # Workers: no background service threads exist; nothing to join.
+        return
+
+    @staticmethod
+    def create_local_server() -> "Server":
+        """Single-process server for smoke tests (reference
+        server_lib.py:216-239 parity, SURVEY.md §4)."""
+        return Server(cluster=None, job_name="worker", task_index=0)

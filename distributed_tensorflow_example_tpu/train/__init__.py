@@ -1,0 +1,38 @@
+"""Training scaffolding: the Supervisor / MonitoredTrainingSession layer.
+
+TPU-native replacement for the reference's ``$TF/python/training`` stack
+(SURVEY.md §2.2): TrainState instead of graph-resident Variables +
+global_step, a jit-compiled sync step instead of SyncReplicasOptimizer, and
+a hook-driven Trainer instead of Supervisor's background threads.
+"""
+
+from .state import TrainState
+from .optimizers import make_optimizer
+from .hooks import (
+    CheckpointSaverHook,
+    GlobalStepWaiterHook,
+    Hook,
+    LoggingHook,
+    NanHook,
+    ProfilerHook,
+    StepCounterHook,
+    StopAtStepHook,
+    SummaryHook,
+)
+
+
+def __getattr__(name):
+    # Trainer is lazy to break the import cycle
+    # parallel.sync_replicas → train.state → (this package) → trainer →
+    # parallel.sync_replicas.
+    if name == "Trainer":
+        from .trainer import Trainer
+        return Trainer
+    raise AttributeError(name)
+
+__all__ = [
+    "TrainState", "make_optimizer", "Trainer",
+    "Hook", "LoggingHook", "StopAtStepHook", "CheckpointSaverHook",
+    "StepCounterHook", "NanHook", "SummaryHook", "GlobalStepWaiterHook",
+    "ProfilerHook",
+]

@@ -1,0 +1,226 @@
+"""Training hooks — the ``basic_session_run_hooks`` family (SURVEY.md §2.2).
+
+Parity map (reference → here):
+
+- ``LoggingTensorHook`` (:169)    → :class:`LoggingHook`
+- ``StopAtStepHook`` (:393)       → :class:`StopAtStepHook`
+- ``CheckpointSaverHook`` (:524)  → :class:`CheckpointSaverHook` (also covers
+  the Supervisor's SVTimerCheckpointThread via ``save_secs``)
+- ``StepCounterHook`` (:674)      → :class:`StepCounterHook`
+- ``NanTensorHook`` (:761)        → :class:`NanHook`
+- ``SummarySaverHook`` (:793)     → :class:`SummaryHook` (JSONL, §5.5)
+- ``GlobalStepWaiterHook`` (:902) → :class:`GlobalStepWaiterHook` (no-op on
+  TPU: it staggered *async* workers; SPMD replicas are lockstep by
+  construction — kept for API compatibility)
+- ``ProfilerHook`` (:1013)        → :class:`ProfilerHook` (jax.profiler
+  traces instead of chrome-trace RunMetadata, §5.1)
+
+Contract: hooks run on every process but side-effecting hooks act only on
+the chief (process 0), mirroring the chief-only Supervisor services
+(SURVEY.md §3.2). ``after_step`` may return ``True`` to request a stop
+(the Coordinator's should_stop analogue).
+
+Hooks that need metric *values* declare ``every_steps``; the trainer only
+materializes device metrics on steps where some hook wants them, so the
+steady-state loop stays free of host syncs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..ckpt.checkpoint import CheckpointManager
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsLogger, RateTracker
+
+log = get_logger("hooks")
+
+
+def _is_chief() -> bool:
+    return jax.process_index() == 0
+
+
+class Hook:
+    every_steps: int = 0      # 0 => never needs materialized metrics
+
+    def begin(self, trainer) -> None: ...
+    def after_step(self, trainer, step: int,
+                   metrics: dict[str, float] | None) -> bool | None: ...
+    def end(self, trainer) -> None: ...
+
+    def wants_metrics(self, step: int) -> bool:
+        return self.every_steps > 0 and step % self.every_steps == 0
+
+
+class LoggingHook(Hook):
+    """Print selected metrics every N steps (LoggingTensorHook parity)."""
+
+    def __init__(self, every_steps: int = 100, keys: list[str] | None = None):
+        self.every_steps = every_steps
+        self.keys = keys
+
+    def after_step(self, trainer, step, metrics):
+        if metrics is None or not self.wants_metrics(step) or not _is_chief():
+            return
+        keys = self.keys or [k for k in metrics if k != "step"]
+        body = " ".join(f"{k}={metrics[k]:.6g}" for k in keys if k in metrics)
+        log.info("step %d: %s", step, body)
+
+
+class StopAtStepHook(Hook):
+    def __init__(self, last_step: int):
+        self.last_step = last_step
+
+    def after_step(self, trainer, step, metrics):
+        return step >= self.last_step
+
+
+class StepCounterHook(Hook):
+    """steps/sec + examples/sec(/chip) every N steps."""
+
+    def __init__(self, every_steps: int = 100, batch_size: int = 0,
+                 metrics_logger: MetricsLogger | None = None):
+        self.every_steps = every_steps
+        self.tracker = RateTracker(batch_size)
+        self.metrics_logger = metrics_logger
+        self.last_rates: dict[str, float] = {}
+
+    def begin(self, trainer):
+        self.tracker.start(int(trainer.start_step))
+
+    def after_step(self, trainer, step, metrics):
+        if self.every_steps <= 0 or step % self.every_steps:
+            return
+        self.last_rates = self.tracker.rates(step)
+        if not self.last_rates or not _is_chief():
+            return
+        log.info("step %d: %.1f steps/s, %s", step,
+                 self.last_rates["steps_per_sec"],
+                 (f"{self.last_rates['examples_per_sec_per_chip']:.1f} "
+                  "examples/s/chip"
+                  if "examples_per_sec_per_chip" in self.last_rates else ""))
+        if self.metrics_logger:
+            self.metrics_logger.log({"step": step, **self.last_rates})
+
+    def wants_metrics(self, step):
+        # never needs metric *values* — don't force a device→host sync
+        # (rates are wall-clock only; the async dispatch queue stays full)
+        return False
+
+
+class CheckpointSaverHook(Hook):
+    """Save every N steps and/or T seconds; always saves at end.
+
+    Chief-only writes are enforced inside CheckpointManager (SURVEY.md
+    §3.4: non-chief never writes)."""
+
+    def __init__(self, manager: CheckpointManager, *,
+                 save_steps: int = 0, save_secs: float = 0.0):
+        self.manager = manager
+        self.save_steps = save_steps
+        self.save_secs = save_secs
+        self._last_save_t = time.time()
+
+    def _due(self, step: int) -> bool:
+        if self.save_steps and step % self.save_steps == 0:
+            return True
+        if self.save_secs and time.time() - self._last_save_t >= self.save_secs:
+            return True
+        return False
+
+    def after_step(self, trainer, step, metrics):
+        if self._due(step):
+            self.manager.save(trainer.state, step)
+            self._last_save_t = time.time()
+
+    def end(self, trainer):
+        step = int(jax.device_get(trainer.state.step))
+        if self.manager.latest_step() != step:
+            self.manager.save(trainer.state, step)
+
+
+class NanHook(Hook):
+    """Stop (or raise) on NaN/Inf loss — NanTensorHook parity. Forces a
+    per-step host sync; enable only when debugging (obs.check_nans)."""
+
+    every_steps = 1
+
+    def __init__(self, fail_on_nan: bool = True):
+        self.fail_on_nan = fail_on_nan
+
+    def after_step(self, trainer, step, metrics):
+        if metrics is None:
+            return
+        loss = metrics.get("loss")
+        if loss is not None and not np.isfinite(loss):
+            msg = f"non-finite loss {loss} at step {step}"
+            if self.fail_on_nan:
+                raise FloatingPointError(msg)
+            log.error("%s — requesting stop", msg)
+            return True
+
+
+class SummaryHook(Hook):
+    """Write scalar metrics to the JSONL sink every N steps
+    (SummarySaverHook / summary-thread parity, SURVEY.md §5.5)."""
+
+    def __init__(self, metrics_logger: MetricsLogger, every_steps: int = 100):
+        self.metrics_logger = metrics_logger
+        self.every_steps = every_steps
+
+    def after_step(self, trainer, step, metrics):
+        if metrics is None or not self.wants_metrics(step):
+            return
+        self.metrics_logger.log({"step": step, **metrics})
+
+    def end(self, trainer):
+        self.metrics_logger.close()
+
+
+class GlobalStepWaiterHook(Hook):
+    """Reference: delayed async-worker starts until the chief advanced the
+    global step (basic_session_run_hooks.py:902). SPMD sync training has no
+    async stagger; kept as an explicit no-op so launch configs port."""
+
+    def __init__(self, wait_until_step: int = 0):
+        self.wait_until_step = wait_until_step
+
+    def begin(self, trainer):
+        if self.wait_until_step:
+            log.info("GlobalStepWaiterHook is a no-op under SPMD sync "
+                     "training (wait_until_step=%d ignored)",
+                     self.wait_until_step)
+
+
+class ProfilerHook(Hook):
+    """Capture a jax.profiler trace for steps in [start, stop)
+    (ProfilerHook/timeline parity, SURVEY.md §5.1)."""
+
+    def __init__(self, profile_dir: str, start_step: int, stop_step: int):
+        self.profile_dir = profile_dir
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self._active = False
+
+    def after_step(self, trainer, step, metrics):
+        if not _is_chief():
+            return
+        if not self._active and step >= self.start_step and step < self.stop_step:
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+        elif self._active and step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def end(self, trainer):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def wants_metrics(self, step):
+        # needs step boundaries around the window, not values
+        return False

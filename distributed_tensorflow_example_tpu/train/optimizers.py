@@ -1,0 +1,50 @@
+"""Optimizer construction (optax).
+
+The reference wrapped a base ``tf.train.GradientDescentOptimizer`` in
+SyncReplicasOptimizer (SURVEY.md §2.1); the sync wrapper is gone (it lives in
+the compiled step), so this module only builds the *base* transformation
+chain: schedule → clip → optimizer → weight decay.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from ..config import OptimizerConfig
+
+
+def make_schedule(cfg: OptimizerConfig):
+    base = cfg.learning_rate
+    if cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
+        sched = optax.constant_schedule(base)
+    elif cfg.decay_schedule == "cosine":
+        sched = optax.cosine_decay_schedule(base, cfg.total_steps)
+    elif cfg.decay_schedule == "linear":
+        sched = optax.linear_schedule(base, 0.0, cfg.total_steps)
+    else:
+        raise ValueError(f"unknown decay_schedule {cfg.decay_schedule!r}")
+    if cfg.warmup_steps > 0:
+        warm = optax.linear_schedule(0.0, base, cfg.warmup_steps)
+        sched = optax.join_schedules([warm, sched], [cfg.warmup_steps])
+    return sched
+
+
+def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    sched = make_schedule(cfg)
+    parts: list[optax.GradientTransformation] = []
+    if cfg.grad_clip_norm > 0:
+        parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    name = cfg.name.lower()
+    if name == "sgd":
+        parts.append(optax.sgd(sched))
+    elif name == "momentum":
+        parts.append(optax.sgd(sched, momentum=cfg.momentum))
+    elif name == "adam":
+        parts.append(optax.adam(sched))
+    elif name == "adamw":
+        parts.append(optax.adamw(sched, weight_decay=cfg.weight_decay))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    if cfg.weight_decay > 0 and name not in ("adamw",):
+        parts.insert(-1, optax.add_decayed_weights(cfg.weight_decay))
+    return optax.chain(*parts)

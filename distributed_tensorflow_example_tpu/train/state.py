@@ -1,0 +1,60 @@
+"""TrainState: the device-resident training state pytree.
+
+Replaces the reference's graph-resident state: ``tf.Variable`` weights pinned
+to PS tasks, the shared ``global_step`` variable (training_util.py:40 in the
+reference stack, SURVEY.md §2.2), and the optimizer slot variables. Here all
+of it is one immutable pytree threaded through the compiled step —
+``global_step`` is just the ``step`` leaf (SURVEY.md §7 layer 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+PyTree = Any
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=("step", "params", "opt_state", "extras", "rng"),
+         meta_fields=())
+@dataclasses.dataclass
+class TrainState:
+    """Immutable training state. ``step`` is the global step counter.
+
+    ``extras`` holds non-trained mutable model state (e.g. BatchNorm running
+    statistics) — the analogue of the reference's non-trainable Variables,
+    which also lived on the PS but received no gradients.
+    """
+
+    step: jax.Array            # i32 scalar
+    params: PyTree
+    opt_state: PyTree
+    extras: PyTree             # non-trained model state ({} when unused)
+    rng: jax.Array             # PRNG key threaded through dropout etc.
+
+    @classmethod
+    def create(cls, *, params: PyTree, tx: optax.GradientTransformation,
+               extras: PyTree | None = None,
+               rng: jax.Array | int = 0) -> "TrainState":
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=tx.init(params), extras=extras or {}, rng=rng)
+
+    def replace(self, **kw: Any) -> "TrainState":
+        return dataclasses.replace(self, **kw)
+
+
+def param_count(params: PyTree) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def param_bytes(params: PyTree) -> int:
+    return sum(int(x.size * x.dtype.itemsize)
+               for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,218 @@
+"""Trainer: the Supervisor / MonitoredTrainingSession replacement.
+
+The reference's bring-up (SURVEY.md §3.2) was: chief restores-or-inits and
+starts summary/checkpoint/step-counter threads; workers poll until the
+session is ready; then everyone loops ``sess.run(train_op)``. Under SPMD
+there is no session to wait for — every process deterministically builds the
+same state (or restores the same checkpoint) and runs the same compiled
+step — so the Trainer is a plain loop plus the hook machinery:
+
+- restore-or-init          → :func:`~..ckpt.checkpoint.restore_or_init`
+  (prepare_session parity)
+- Supervisor threads       → hooks (chief-side effects only)
+- Coordinator should_stop  → hooks returning True / StopAtStepHook
+- per-step feed_dict       → ShardedLoader batches placed with NamedSharding
+
+Perf note: the loop is async-dispatch — device metrics are only pulled to
+host on steps where some hook asks (``wants_metrics``), so steady-state
+steps queue back-to-back on device with no host round-trip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+from ..ckpt.checkpoint import CheckpointManager
+from ..config import TrainConfig
+from ..data.loader import make_loader
+from ..parallel.mesh import batch_axis_size, build_mesh
+from ..parallel.sync_replicas import SyncReplicas
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsLogger
+from . import hooks as hooks_lib
+from .optimizers import make_optimizer
+from .state import TrainState, param_count
+
+log = get_logger("trainer")
+
+
+class Trainer:
+    """End-to-end training driver for a registered model.
+
+    Args:
+      model: Model-protocol object.
+      config: TrainConfig.
+      train_arrays/eval_arrays: batch-keyed numpy arrays (e.g. {"x","y"}).
+      mesh: optional prebuilt Mesh (default: from config.mesh over all
+        devices).
+      hooks: extra hooks appended after the default set.
+      process_index/num_processes: data-sharding coordinates (default: from
+        the JAX runtime).
+    """
+
+    def __init__(self, model, config: TrainConfig,
+                 train_arrays: dict[str, np.ndarray],
+                 eval_arrays: dict[str, np.ndarray] | None = None,
+                 *, mesh=None, hooks: list[hooks_lib.Hook] | None = None,
+                 process_index: int | None = None,
+                 num_processes: int | None = None):
+        self.model = model
+        self.config = config
+        self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
+        self.train_arrays = train_arrays
+        self.eval_arrays = eval_arrays
+
+        self.tx = make_optimizer(config.optimizer)
+        rules = model.sharding_rules(config.mesh)
+        self.sync = SyncReplicas(model.loss, self.tx, self.mesh,
+                                 sync=config.sync, rules=rules)
+
+        self.ckpt_manager = (
+            CheckpointManager(config.checkpoint.directory,
+                              max_to_keep=config.checkpoint.max_to_keep,
+                              keep_every_n_hours=(
+                                  config.checkpoint.keep_checkpoint_every_n_hours))
+            if config.checkpoint.directory else None)
+        self.metrics_logger = MetricsLogger(config.obs.metrics_path)
+
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.num_processes = (jax.process_count() if num_processes is None
+                              else num_processes)
+
+        self.state: TrainState | None = None
+        self.start_step = 0
+        self.hooks = self._default_hooks() + list(hooks or [])
+        self._eval_fn = None
+
+    # ------------------------------------------------------------------
+    def _default_hooks(self) -> list[hooks_lib.Hook]:
+        """The hook set MonitoredTrainingSession wires for a chief
+        (monitored_session.py:428-609 parity, SURVEY.md §2.2)."""
+        cfg = self.config
+        hs: list[hooks_lib.Hook] = [
+            hooks_lib.StopAtStepHook(cfg.train_steps),
+            hooks_lib.LoggingHook(cfg.obs.log_every_steps),
+            hooks_lib.StepCounterHook(cfg.obs.log_every_steps,
+                                      batch_size=cfg.data.batch_size,
+                                      metrics_logger=self.metrics_logger),
+        ]
+        if cfg.obs.summary_every_steps:
+            hs.append(hooks_lib.SummaryHook(self.metrics_logger,
+                                            cfg.obs.summary_every_steps))
+        if cfg.obs.check_nans:
+            hs.append(hooks_lib.NanHook())
+        if self.ckpt_manager and (cfg.checkpoint.save_steps
+                                  or cfg.checkpoint.save_secs):
+            hs.append(hooks_lib.CheckpointSaverHook(
+                self.ckpt_manager, save_steps=cfg.checkpoint.save_steps,
+                save_secs=cfg.checkpoint.save_secs))
+        if cfg.obs.profile_steps and cfg.obs.profile_dir:
+            hs.append(hooks_lib.ProfilerHook(cfg.obs.profile_dir,
+                                             *cfg.obs.profile_steps))
+        return hs
+
+    # ------------------------------------------------------------------
+    def initialize(self) -> TrainState:
+        """Restore-or-init (SessionManager.prepare_session parity)."""
+        state = self.sync.init(self.model.init, seed=self.config.seed)
+        if self.ckpt_manager and self.ckpt_manager.latest_step() is not None:
+            step = self.ckpt_manager.latest_step()
+            state = self.ckpt_manager.restore(state)
+            log.info("restored checkpoint at step %d", step)
+        else:
+            log.info("initialized fresh state: %d params",
+                     param_count(state.params))
+        self.state = state
+        self.start_step = int(jax.device_get(state.step))
+        return state
+
+    def _loader(self) -> Iterator[dict[str, np.ndarray]]:
+        return make_loader(
+            self.train_arrays, self.config.data.batch_size,
+            prefetch=self.config.data.prefetch,
+            process_index=self.process_index,
+            num_processes=self.num_processes,
+            shuffle=self.config.data.shuffle,
+            seed=self.config.data.seed)
+
+    # ------------------------------------------------------------------
+    def train(self) -> tuple[TrainState, dict[str, Any]]:
+        if self.state is None:
+            self.initialize()
+        state = self.state
+        for h in self.hooks:
+            h.begin(self)
+
+        loader = self._loader()
+        step = self.start_step
+        stop = step >= self.config.train_steps
+        device_metrics: dict | None = None
+        t_start = time.perf_counter()
+
+        while not stop:
+            batch = self.sync.shard_batch(next(loader))
+            state, device_metrics = self.sync.step(state, batch)
+            self.state = state
+            step += 1
+
+            wants = any(h.wants_metrics(step) for h in self.hooks)
+            host_metrics = None
+            if wants:
+                host_metrics = {k: float(v) for k, v in
+                                jax.device_get(device_metrics).items()}
+            for h in self.hooks:
+                if h.after_step(self, step, host_metrics):
+                    stop = True
+
+            if (self.config.eval_every_steps
+                    and step % self.config.eval_every_steps == 0
+                    and self.eval_arrays is not None):
+                ev = self.evaluate(state)
+                log.info("eval @ step %d: %s", step,
+                         {k: round(v, 4) for k, v in ev.items()})
+                self.metrics_logger.log({"step": step, "eval": ev})
+
+        # block on the final step so hook teardown sees settled state
+        jax.block_until_ready(state.params)
+        wall = time.perf_counter() - t_start
+        for h in self.hooks:
+            h.end(self)
+
+        summary: dict[str, Any] = {
+            "final_step": step,
+            "wall_time_sec": wall,
+            "steps_per_sec": (step - self.start_step) / wall if wall else 0.0,
+        }
+        if device_metrics is not None:
+            summary["final_metrics"] = {
+                k: float(v) for k, v in jax.device_get(device_metrics).items()}
+        if self.eval_arrays is not None:
+            summary["eval"] = self.evaluate(state)
+        return state, summary
+
+    # ------------------------------------------------------------------
+    def evaluate(self, state: TrainState,
+                 batch_size: int | None = None) -> dict[str, float]:
+        """Forward-only metrics over the eval set (the reference's final
+        test-accuracy pass, SURVEY.md §2.1 'Train loop + eval')."""
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(self.model.eval_metrics)
+        bs = batch_size or self.config.data.batch_size
+        n = len(next(iter(self.eval_arrays.values())))
+        bs = min(bs, n)
+        totals: dict[str, float] = {}
+        count = 0
+        for i in range(0, n - bs + 1, bs):
+            batch = {k: v[i:i + bs] for k, v in self.eval_arrays.items()}
+            out = jax.device_get(
+                self._eval_fn(state.params, state.extras,
+                              self.sync.shard_batch(batch)))
+            for k, v in out.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * bs
+            count += bs
+        return {k: v / count for k, v in totals.items()} if count else {}

@@ -1,0 +1,6 @@
+"""Utilities: logging, metrics, timing, profiling (SURVEY.md §5.1/§5.5)."""
+
+from .logging import get_logger
+from .metrics import MetricsLogger, RateTracker
+
+__all__ = ["get_logger", "MetricsLogger", "RateTracker"]

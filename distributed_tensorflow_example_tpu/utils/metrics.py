@@ -1,0 +1,76 @@
+"""Metrics: JSONL sink + rate tracking.
+
+Replaces ``tf.summary`` FileWriter event files and StepCounterHook's
+steps/sec (SURVEY.md §5.5) with a JSONL stream (one object per record —
+trivially greppable and the format ``bench.py`` consumes) plus
+examples/sec/chip computation per the driver metric (BASELINE.json:2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, TextIO
+
+import jax
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics writer; process 0 writes, like the chief's
+    summary thread (supervisor.py:675-679 parity)."""
+
+    def __init__(self, path: str | None = None, *, also_stdout: bool = False):
+        self.path = path
+        self.also_stdout = also_stdout
+        self._f: TextIO | None = None
+        if path and jax.process_index() == 0:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+
+    def log(self, record: dict[str, Any]) -> None:
+        record = dict(record, time=time.time())
+        line = json.dumps(record, default=float)
+        if self._f is not None:
+            self._f.write(line + "\n")
+        if self.also_stdout and jax.process_index() == 0:
+            print(line, flush=True)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class RateTracker:
+    """steps/sec and examples/sec/chip over a sliding window
+    (StepCounterHook parity, basic_session_run_hooks.py:674)."""
+
+    def __init__(self, batch_size: int = 0, num_chips: int | None = None):
+        self.batch_size = batch_size
+        self.num_chips = num_chips or jax.device_count()
+        self._t0: float | None = None
+        self._s0 = 0
+
+    def start(self, step: int) -> None:
+        self._t0 = time.perf_counter()
+        self._s0 = step
+
+    def rates(self, step: int) -> dict[str, float]:
+        """Rates since the last start(); restarts the window."""
+        now = time.perf_counter()
+        if self._t0 is None or step <= self._s0:
+            self.start(step)
+            return {}
+        dt = now - self._t0
+        steps = step - self._s0
+        out = {
+            "steps_per_sec": steps / dt,
+            "sec_per_step": dt / steps,
+        }
+        if self.batch_size:
+            out["examples_per_sec"] = steps * self.batch_size / dt
+            out["examples_per_sec_per_chip"] = (
+                out["examples_per_sec"] / self.num_chips)
+        self.start(step)
+        return out

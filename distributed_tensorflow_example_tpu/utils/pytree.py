@@ -1,0 +1,30 @@
+"""Shared pytree helpers: path rendering + leaf predicates.
+
+One canonical ``/``-joined path string per leaf, used consistently by
+sharding rules (rule regexes match these paths) and checkpoint keys (npz
+entries are keyed by them) — a single renderer so the two can never drift.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def path_str(path) -> str:
+    """Render a jax key-path as 'a/b/0/c'."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def is_prng_key(x) -> bool:
+    return isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+        x.dtype, jax.dtypes.prng_key)

@@ -1,0 +1,30 @@
+"""Test fixture: virtual 8-device CPU mesh.
+
+The JAX analogue of the reference's in-process multi-server cluster fixture
+``tf.test.create_local_cluster`` (SURVEY.md §4): 8 XLA host devices in one
+process give real shardings and real collectives with no TPU pod.
+
+Must run before any jax computation: XLA_FLAGS is read at backend init, and
+jax_platforms is forced to cpu so tests never ride the (slow, remote) axon
+TPU tunnel.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu8():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 cpu devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,64 @@
+"""ClusterSpec / legacy-role mapping tests (SURVEY.md §2.2 parity surface)."""
+
+import pytest
+
+from distributed_tensorflow_example_tpu.cluster import (
+    ClusterSpec, resolve_legacy_role)
+from distributed_tensorflow_example_tpu.runtime.server import Server
+
+
+CLUSTER = {"ps": ["ps0:2222", "ps1:2222"],
+           "worker": ["w0:2222", "w1:2222", "w2:2222"]}
+
+
+def test_cluster_spec_surface():
+    cs = ClusterSpec(CLUSTER)
+    assert cs.jobs == ["ps", "worker"]
+    assert cs.num_tasks("worker") == 3
+    assert cs.num_tasks("ps") == 2
+    assert cs.task_address("worker", 1) == "w1:2222"
+    assert cs.job_tasks("ps") == ["ps0:2222", "ps1:2222"]
+    assert cs.as_dict() == CLUSTER
+    assert cs.num_workers == 3 and cs.num_ps == 2
+    assert cs.coordinator_address() == "w0:2222"
+
+
+def test_cluster_spec_from_mapping_with_indices():
+    cs = ClusterSpec({"worker": {0: "a:1", 2: "c:3"}})
+    assert cs.task_indices("worker") == [0, 2]
+    assert cs.task_address("worker", 2) == "c:3"
+
+
+def test_legacy_worker_role():
+    cs = ClusterSpec(CLUSTER)
+    role = resolve_legacy_role(cs, "worker", 0)
+    assert role.should_run and role.is_chief and role.process_index == 0
+    role2 = resolve_legacy_role(cs, "worker", 2)
+    assert role2.should_run and not role2.is_chief
+    assert role2.num_processes == 3
+
+
+def test_legacy_ps_role_exits_cleanly():
+    """The reference's `if job_name == "ps": server.join()` must keep
+    working: ps maps to a clean no-op (SURVEY.md §7 hard-parts item 3)."""
+    cs = ClusterSpec(CLUSTER)
+    role = resolve_legacy_role(cs, "ps", 1)
+    assert not role.should_run
+    assert "No PS role on TPU" in role.notice
+
+
+def test_task_index_out_of_range():
+    cs = ClusterSpec(CLUSTER)
+    with pytest.raises(ValueError):
+        resolve_legacy_role(cs, "worker", 7)
+
+
+def test_server_parity_handles():
+    srv = Server.create_local_server()
+    assert srv.role.is_chief
+    srv.join()  # returns immediately for workers
+    assert srv.target.startswith("tpu://process/")
+
+    ps = Server(CLUSTER, job_name="ps", task_index=0)
+    ps.join()  # logs notice, returns — old launch scripts exit 0
+    assert not ps.role.should_run

@@ -1,0 +1,77 @@
+"""Mesh construction + sharding-rule tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_example_tpu.parallel.mesh import (
+    AxisNames, MeshConfig, batch_axis_size, build_mesh, local_mesh)
+from distributed_tensorflow_example_tpu.parallel.sharding import (
+    ShardingRules, batch_pspec, shard_batch, shard_params)
+
+
+def test_default_mesh_all_data(cpu8):
+    mesh = build_mesh(devices=cpu8)
+    assert mesh.shape[AxisNames.DATA] == 8
+    assert batch_axis_size(mesh) == 8
+    assert mesh.axis_names == AxisNames.ALL
+
+
+def test_mesh_wildcard_axis(cpu8):
+    mesh = build_mesh({"data": -1, "model": 2}, devices=cpu8)
+    assert mesh.shape[AxisNames.DATA] == 4
+    assert mesh.shape[AxisNames.MODEL] == 2
+
+
+def test_mesh_shape_mismatch_raises(cpu8):
+    with pytest.raises(ValueError):
+        build_mesh({"data": 3}, devices=cpu8)
+    with pytest.raises(ValueError):
+        build_mesh({"data": -1, "model": -1}, devices=cpu8)
+
+
+def test_local_mesh_subset():
+    mesh = local_mesh(4)
+    assert batch_axis_size(mesh) == 4
+
+
+def test_batch_sharding_splits_leading_dim(cpu8):
+    mesh = build_mesh(devices=cpu8)
+    batch = {"x": np.zeros((16, 4), np.float32)}
+    sharded = shard_batch(mesh, batch)
+    # each device holds 16/8 = 2 rows
+    shard_shapes = {s.data.shape for s in sharded["x"].addressable_shards}
+    assert shard_shapes == {(2, 4)}
+
+
+def test_sharding_rules_first_match_wins():
+    rules = ShardingRules(rules=[
+        (r"attn/.*kernel", P(None, "model")),
+        (r"kernel", P()),
+    ])
+    assert rules.spec_for("layer0/attn/q/kernel", (64, 64)) == P(None, "model")
+    assert rules.spec_for("layer0/mlp/kernel", (64, 64)) == P()
+
+
+def test_fsdp_fallback_shards_largest_divisible_dim():
+    rules = ShardingRules(fsdp_axis_size=4, fsdp_min_size=16)
+    spec = rules.spec_for("fc/kernel", (8, 12))
+    assert spec == P(None, AxisNames.FSDP)   # 12 % 4 == 0, largest div dim
+    # tiny params stay replicated
+    assert rules.spec_for("fc/bias", (10,)) == P()
+    # nothing divisible → replicated
+    assert rules.spec_for("odd/kernel", (7, 9)) == P()
+
+
+def test_shard_params_fsdp_layout(cpu8):
+    mesh = build_mesh({"fsdp": 8}, devices=cpu8)
+    params = {"w": np.ones((16, 32), np.float32),
+              "b": np.zeros((32,), np.float32)}
+    rules = ShardingRules(fsdp_axis_size=8, fsdp_min_size=64)
+    placed = shard_params(mesh, params, rules)
+    # w sharded over fsdp on dim 1 (32 is largest and divisible)
+    assert {s.data.shape for s in placed["w"].addressable_shards} == {(16, 4)}
+    # b replicated
+    assert {s.data.shape for s in placed["b"].addressable_shards} == {(32,)}

@@ -1,0 +1,135 @@
+"""Sync-replica semantics tests.
+
+The load-bearing invariant (SURVEY.md §4 item 2, §7 hard-parts item 2): the
+N-device sync step must equal the 1-device step on the same global batch —
+the promise SyncReplicasOptimizer's docs make for the reference
+(sync_replicas_optimizer.py:49-55).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import (OptimizerConfig,
+                                                       SyncConfig)
+from distributed_tensorflow_example_tpu.models.mlp import MLP
+from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.train.optimizers import make_optimizer
+
+BATCH = 32
+
+
+def _setup(n_dev, mode="auto", accum=1, seed=0):
+    model = MLP(in_dim=20, hidden=16, num_classes=4)
+    mesh = local_mesh(n_dev)
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1))
+    sync = SyncReplicas(model.loss, tx, mesh,
+                        sync=SyncConfig(mode=mode, accum_steps=accum))
+    state = sync.init(model.init, seed=seed)
+    return model, sync, state
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"x": rs.rand(BATCH, 20).astype(np.float32),
+            "y": rs.randint(0, 4, size=(BATCH,), dtype=np.int32)}
+
+
+def _params_flat(state):
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(state.params))
+
+
+def assert_trees_close(a, b, **kw):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(x, y, **kw), a, b)
+
+
+def test_loss_matches_numpy_oracle():
+    """MLP fwd + softmax-xent against a hand-written numpy computation."""
+    model, sync, state = _setup(1)
+    batch = _batch()
+    loss, (aux, _) = model.loss(
+        jax.device_get(state.params), {}, batch, jax.random.key(0))
+
+    p = _params_flat(state)
+    h = np.maximum(batch["x"] @ p["fc1"]["kernel"] + p["fc1"]["bias"], 0.0)
+    logits = h @ p["fc2"]["kernel"] + p["fc2"]["bias"]
+    z = logits - logits.max(axis=1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+    want = -logp[np.arange(BATCH), batch["y"]].mean()
+    np.testing.assert_allclose(float(loss), want, rtol=1e-5)
+    acc = (logits.argmax(1) == batch["y"]).mean()
+    np.testing.assert_allclose(float(aux["accuracy"]), acc, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["auto", "shard_map"])
+def test_nchip_step_equals_single_chip(mode):
+    """8-device sync step == 1-device big-batch step on the same batch."""
+    _, sync1, state1 = _setup(1)
+    _, sync8, state8 = _setup(8, mode=mode)
+    batch = _batch()
+
+    s1, m1 = sync1.step(state1, sync1.shard_batch(batch))
+    s8, m8 = sync8.step(state8, sync8.shard_batch(batch))
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]),
+                               rtol=1e-5)
+    assert_trees_close(_params_flat(s1), _params_flat(s8),
+                       rtol=2e-5, atol=1e-6)
+    assert int(s8.step) == 1
+
+
+def test_shard_map_mode_equals_auto_mode():
+    _, sync_a, state_a = _setup(8, mode="auto")
+    _, sync_s, state_s = _setup(8, mode="shard_map")
+    batch = _batch()
+    sa, ma = sync_a.step(state_a, sync_a.shard_batch(batch))
+    ss, ms = sync_s.step(state_s, sync_s.shard_batch(batch))
+    np.testing.assert_allclose(float(ma["loss"]), float(ms["loss"]),
+                               rtol=1e-5)
+    assert_trees_close(_params_flat(sa), _params_flat(ss),
+                       rtol=2e-5, atol=1e-6)
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=4 microbatching == single full-batch step (the
+    accumulate-N-then-apply residue, module docstring)."""
+    _, sync1, state1 = _setup(1, accum=1)
+    _, sync4, state4 = _setup(1, accum=4)
+    batch = _batch()
+    s1, m1 = sync1.step(state1, sync1.shard_batch(batch))
+    s4, m4 = sync4.step(state4, sync4.shard_batch(batch))
+    np.testing.assert_allclose(float(m1["loss"]), float(m4["loss"]),
+                               rtol=1e-5)
+    assert_trees_close(_params_flat(s1), _params_flat(s4),
+                       rtol=2e-5, atol=1e-6)
+
+
+def test_replicas_to_aggregate_mismatch_rejected():
+    model = MLP(in_dim=20, hidden=16, num_classes=4)
+    mesh = local_mesh(8)
+    tx = make_optimizer(OptimizerConfig())
+    with pytest.raises(ValueError, match="replicas_to_aggregate"):
+        SyncReplicas(model.loss, tx, mesh,
+                     sync=SyncConfig(replicas_to_aggregate=4))
+
+
+def test_multi_step_training_reduces_loss():
+    model, sync, state = _setup(8)
+
+    def learnable_batch(seed):
+        rs = np.random.RandomState(seed)
+        protos = np.random.RandomState(99).rand(4, 20).astype(np.float32)
+        y = rs.randint(0, 4, size=(BATCH,)).astype(np.int32)
+        x = protos[y] + rs.randn(BATCH, 20).astype(np.float32) * 0.1
+        return {"x": x, "y": y}
+
+    losses = []
+    for i in range(30):
+        state, m = sync.step(state, sync.shard_batch(learnable_batch(i % 4)))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7
+    assert int(state.step) == 30
