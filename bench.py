@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Benchmark: MNIST MLP sync-replica training throughput (examples/sec/chip).
+
+The driver-defined headline metric (BASELINE.json:2). The reference
+publishes no numbers (BASELINE.md), so the recorded single-chip measurement
+in ``bench_baseline.json`` is the baseline; ``vs_baseline`` is
+measured/baseline (>1 is faster than the recorded baseline).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from distributed_tensorflow_example_tpu.config import (  # noqa: E402
+    DataConfig, OptimizerConfig, TrainConfig)
+from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist  # noqa: E402
+from distributed_tensorflow_example_tpu.models import get_model  # noqa: E402
+from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh  # noqa: E402
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (  # noqa: E402
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.train.optimizers import (  # noqa: E402
+    make_optimizer)
+
+BATCH = 8192
+WARMUP = 10
+STEPS = 100
+
+
+def main() -> None:
+    n_dev = len(jax.devices())
+    mesh = build_mesh()          # all devices on the data axis
+    cfg = TrainConfig(model="mlp", dtype="bfloat16",
+                      data=DataConfig(batch_size=BATCH),
+                      optimizer=OptimizerConfig(name="sgd", learning_rate=0.5))
+    model = get_model("mlp", cfg)
+    tx = make_optimizer(cfg.optimizer)
+    sync = SyncReplicas(model.loss, tx, mesh)
+    state = sync.init(model.init, seed=0)
+
+    data = synthetic_mnist(num_train=BATCH * 2, num_test=16)
+    batches = [
+        sync.shard_batch({"x": data["train_x"][i * BATCH:(i + 1) * BATCH],
+                          "y": data["train_y"][i * BATCH:(i + 1) * BATCH]})
+        for i in range(2)
+    ]
+
+    for i in range(WARMUP):
+        state, m = sync.step(state, batches[i % 2])
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, m = sync.step(state, batches[i % 2])
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    eps_chip = STEPS * BATCH / dt / n_dev
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            base = json.load(f).get("examples_per_sec_per_chip")
+        if base:
+            vs = eps_chip / base
+
+    print(json.dumps({
+        "metric": "mnist_mlp_examples_per_sec_per_chip",
+        "value": round(eps_chip, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Command-line entrypoints (SURVEY.md §2.1 example-script layer)."""

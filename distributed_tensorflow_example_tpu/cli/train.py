@@ -1,0 +1,163 @@
+"""Trainer entrypoint — the reference example script, TPU-native.
+
+Preserves the reference's CLI surface (SURVEY.md §2.1, §3.1;
+BASELINE.json:5): ``--ps_hosts --worker_hosts --job_name --task_index``
+plus model/training knobs. The launch pattern ports unchanged::
+
+    python -m distributed_tensorflow_example_tpu.cli.train \
+        --job_name=worker --task_index=0 \
+        --worker_hosts=host0:port,host1:port --model=mlp
+
+``--job_name=ps`` prints the no-PS-on-TPU notice and exits 0, so the
+reference's per-role launch scripts keep working (SURVEY.md §7 item 3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..cluster import ClusterSpec, WORKER_JOB
+from ..config import (CheckpointConfig, DataConfig, MeshShape,
+                      ObservabilityConfig, OptimizerConfig, SyncConfig,
+                      TrainConfig, add_legacy_flags, parse_hosts)
+from ..utils.logging import get_logger
+
+log = get_logger("cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native sync data-parallel trainer "
+                    "(distributed-tensorflow-example parity CLI)")
+    add_legacy_flags(p)
+    p.add_argument("--model", default="mlp",
+                   help="mlp | lenet | resnet20 | resnet50 | bert")
+    p.add_argument("--dataset", default=None,
+                   help="default: the model's canonical dataset")
+    p.add_argument("--data_dir", default=None,
+                   help="real dataset directory; omit for synthetic data")
+    p.add_argument("--batch_size", type=int, default=128,
+                   help="GLOBAL batch size")
+    p.add_argument("--train_steps", type=int, default=1000)
+    p.add_argument("--learning_rate", type=float, default=0.5)
+    p.add_argument("--optimizer", default="sgd")
+    p.add_argument("--accum_steps", type=int, default=1)
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--mesh", default="",
+                   help="axis sizes, e.g. 'data=4,model=2' (default: all "
+                        "devices on the data axis)")
+    p.add_argument("--sync_mode", default="auto",
+                   choices=["auto", "shard_map"])
+    p.add_argument("--ckpt_dir", default=None)
+    p.add_argument("--save_steps", type=int, default=0)
+    p.add_argument("--save_secs", type=float, default=0.0)
+    p.add_argument("--max_to_keep", type=int, default=5)
+    p.add_argument("--log_every_steps", type=int, default=100)
+    p.add_argument("--metrics_path", default=None)
+    p.add_argument("--eval_every_steps", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--check_nans", action="store_true")
+    p.add_argument("--profile_dir", default=None)
+    p.add_argument("--profile_steps", default=None,
+                   help="start,stop step range for the profiler hook")
+    return p
+
+
+def parse_mesh(spec: str) -> MeshShape | None:
+    if not spec:
+        return None
+    kw = {}
+    for part in spec.split(","):
+        k, v = part.split("=")
+        kw[k.strip()] = int(v)
+    return MeshShape(**kw)
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    profile_steps = None
+    if args.profile_steps:
+        a, b = args.profile_steps.split(",")
+        profile_steps = (int(a), int(b))
+    return TrainConfig(
+        model=args.model,
+        train_steps=args.train_steps,
+        eval_every_steps=args.eval_every_steps,
+        seed=args.seed,
+        dtype=args.dtype,
+        mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
+        data=DataConfig(dataset=args.dataset or args.model,
+                        data_dir=args.data_dir,
+                        batch_size=args.batch_size, seed=args.seed),
+        optimizer=OptimizerConfig(name=args.optimizer,
+                                  learning_rate=args.learning_rate,
+                                  total_steps=args.train_steps),
+        sync=SyncConfig(accum_steps=args.accum_steps, mode=args.sync_mode),
+        checkpoint=CheckpointConfig(directory=args.ckpt_dir,
+                                    max_to_keep=args.max_to_keep,
+                                    save_steps=args.save_steps,
+                                    save_secs=args.save_secs),
+        obs=ObservabilityConfig(
+            log_every_steps=args.log_every_steps,
+            metrics_path=args.metrics_path,
+            check_nans=args.check_nans,
+            profile_dir=args.profile_dir,
+            profile_steps=profile_steps),
+    )
+
+
+def load_dataset(cfg: TrainConfig):
+    """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts."""
+    name = cfg.data.dataset
+    if name in ("mlp", "mnist", "lenet"):
+        from ..data.mnist import get_mnist
+        d = get_mnist(cfg.data.data_dir, cfg.data.synthetic)
+        flat = name != "lenet"
+        def shape(x):
+            return x if flat else x.reshape(-1, 28, 28, 1)
+        return ({"x": shape(d["train_x"]), "y": d["train_y"]},
+                {"x": shape(d["test_x"]), "y": d["test_y"]})
+    raise SystemExit(f"dataset {name!r} not wired into the CLI yet")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    cluster = None
+    if args.ps_hosts or args.worker_hosts:
+        cluster = ClusterSpec({
+            "ps": parse_hosts(args.ps_hosts),
+            WORKER_JOB: parse_hosts(args.worker_hosts) or ["localhost:0"],
+        })
+
+    from ..runtime.server import Server
+    server = Server(cluster, args.job_name, args.task_index)
+    if not server.role.should_run:          # ps branch: notice + exit 0
+        server.join()
+        return 0
+
+    cfg = config_from_args(args)
+    from ..models import get_model
+    from ..train.trainer import Trainer
+
+    model = get_model(cfg.model, cfg)
+    train_arrays, eval_arrays = load_dataset(cfg)
+    ctx = server.context
+    trainer = Trainer(model, cfg, train_arrays, eval_arrays,
+                      process_index=ctx.process_index if ctx else 0,
+                      num_processes=ctx.num_processes if ctx else 1)
+    state, summary = trainer.train()
+
+    # the reference's closing print: final test accuracy (SURVEY.md §2.1)
+    if "eval" in summary:
+        log.info("final eval: %s",
+                 {k: round(v, 4) for k, v in summary["eval"].items()})
+    log.info("done: step=%d wall=%.1fs steps/sec=%.2f",
+             summary["final_step"], summary["wall_time_sec"],
+             summary["steps_per_sec"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,9 +87,9 @@ def synthetic_mnist(num_train: int = 8192, num_test: int = 1024,
 
 def get_mnist(data_dir: str | None, synthetic: bool = False,
               **synth_kw) -> dict[str, np.ndarray]:
+    """Real MNIST when ``data_dir`` is given (raising if files are missing
+    — silently training on synthetic data would corrupt accuracy claims),
+    synthetic otherwise."""
     if data_dir and not synthetic:
-        try:
-            return load_mnist(data_dir)
-        except FileNotFoundError:
-            pass
+        return load_mnist(data_dir)
     return synthetic_mnist(**synth_kw)

@@ -124,6 +124,16 @@ class CheckpointSaverHook(Hook):
         self.save_steps = save_steps
         self.save_secs = save_secs
         self._last_save_t = time.time()
+        self._last_saved_step: int | None = None
+        # save() is a cross-process collective for non-addressable (fsdp)
+        # arrays, so the *decision* to save must be identical on every
+        # process. Wall-clock cadence is per-process (clock/loop skew) and
+        # would deadlock a multi-host run; step cadence is deterministic.
+        if save_secs and jax.process_count() > 1:
+            raise ValueError(
+                "save_secs is wall-clock-based and not deterministic across "
+                "processes (risk of collective deadlock in save()); use "
+                "save_steps on multi-host runs")
 
     def _due(self, step: int) -> bool:
         if self.save_steps and step % self.save_steps == 0:
@@ -135,12 +145,15 @@ class CheckpointSaverHook(Hook):
     def after_step(self, trainer, step, metrics):
         if self._due(step):
             self.manager.save(trainer.state, step)
+            self._last_saved_step = step
             self._last_save_t = time.time()
 
     def end(self, trainer):
+        # deterministic across processes: depends only on step history
         step = int(jax.device_get(trainer.state.step))
-        if self.manager.latest_step() != step:
+        if self._last_saved_step != step:
             self.manager.save(trainer.state, step)
+            self._last_saved_step = step
 
 
 class NanHook(Hook):
@@ -176,9 +189,8 @@ class SummaryHook(Hook):
         if metrics is None or not self.wants_metrics(step):
             return
         self.metrics_logger.log({"step": step, **metrics})
-
-    def end(self, trainer):
-        self.metrics_logger.close()
+    # note: the MetricsLogger is owned (and closed) by its creator — the
+    # Trainer outlives this hook and may keep logging (eval, re-train)
 
 
 class GlobalStepWaiterHook(Hook):

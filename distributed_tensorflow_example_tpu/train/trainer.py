@@ -26,7 +26,7 @@ from typing import Any, Iterator
 import jax
 import numpy as np
 
-from ..ckpt.checkpoint import CheckpointManager
+from ..ckpt.checkpoint import CheckpointManager, restore_or_init
 from ..config import TrainConfig
 from ..data.loader import make_loader
 from ..parallel.mesh import batch_axis_size, build_mesh
@@ -119,16 +119,16 @@ class Trainer:
     # ------------------------------------------------------------------
     def initialize(self) -> TrainState:
         """Restore-or-init (SessionManager.prepare_session parity)."""
-        state = self.sync.init(self.model.init, seed=self.config.seed)
-        if self.ckpt_manager and self.ckpt_manager.latest_step() is not None:
-            step = self.ckpt_manager.latest_step()
-            state = self.ckpt_manager.restore(state)
-            log.info("restored checkpoint at step %d", step)
+        state, restored = restore_or_init(
+            self.ckpt_manager,
+            lambda: self.sync.init(self.model.init, seed=self.config.seed))
+        self.state = state
+        self.start_step = int(jax.device_get(state.step))
+        if restored:
+            log.info("restored checkpoint at step %d", self.start_step)
         else:
             log.info("initialized fresh state: %d params",
                      param_count(state.params))
-        self.state = state
-        self.start_step = int(jax.device_get(state.step))
         return state
 
     def _loader(self) -> Iterator[dict[str, np.ndarray]]:
@@ -207,12 +207,22 @@ class Trainer:
         bs = min(bs, n)
         totals: dict[str, float] = {}
         count = 0
-        for i in range(0, n - bs + 1, bs):
+        for i in range(0, n, bs):
             batch = {k: v[i:i + bs] for k, v in self.eval_arrays.items()}
+            m = len(next(iter(batch.values())))
+            if m == bs:
+                placed = self.sync.shard_batch(batch)
+            else:
+                # tail batch: may not divide the batch axes — run it
+                # replicated (one recompile; correctness over parallelism
+                # so the full eval set is covered, unlike dropping it)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                rep = NamedSharding(self.mesh, P())
+                placed = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, rep), batch)
             out = jax.device_get(
-                self._eval_fn(state.params, state.extras,
-                              self.sync.shard_batch(batch)))
+                self._eval_fn(state.params, state.extras, placed))
             for k, v in out.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * bs
-            count += bs
+                totals[k] = totals.get(k, 0.0) + float(v) * m
+            count += m
         return {k: v / count for k, v in totals.items()} if count else {}

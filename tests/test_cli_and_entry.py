@@ -1,0 +1,45 @@
+"""CLI + driver-contract tests."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+
+def test_cli_ps_branch_exits_zero():
+    from distributed_tensorflow_example_tpu.cli.train import main
+    rc = main(["--job_name=ps", "--task_index=0",
+               "--ps_hosts=a:1", "--worker_hosts=b:2"])
+    assert rc == 0
+
+
+def test_cli_trains_mlp(tmp_path):
+    from distributed_tensorflow_example_tpu.cli.train import main
+    metrics = tmp_path / "m.jsonl"
+    rc = main(["--model=mlp", "--train_steps=40", "--batch_size=128",
+               "--log_every_steps=20", f"--ckpt_dir={tmp_path}/ckpt",
+               "--save_steps=20", f"--metrics_path={metrics}"])
+    assert rc == 0
+    assert (tmp_path / "ckpt" / "checkpoint").exists()
+    lines = [json.loads(l) for l in metrics.read_text().splitlines()]
+    assert any("steps_per_sec" in l for l in lines)
+
+
+def test_cli_unknown_dataset_errors():
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit):
+        main(["--model=mlp", "--dataset=nope", "--train_steps=1"])
+
+
+def test_graft_entry_contract():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    compiled = jax.jit(fn).lower(*args).compile()
+    assert compiled is not None
+    g.dryrun_multichip(8)
+    g.dryrun_multichip(4)
