@@ -173,9 +173,16 @@ class CheckpointManager:
             if (self.keep_every_n_hours > 0 and
                     now - self._last_kept_forever
                     >= self.keep_every_n_hours * 3600):
-                st.setdefault("kept_forever", []).append(base)
+                if base not in st.get("kept_forever", []):
+                    st.setdefault("kept_forever", []).append(base)
                 self._last_kept_forever = now
             else:
+                # re-saving an existing step (e.g. end-of-run save after a
+                # restore with no new steps) must not create a duplicate
+                # ring entry — rotation would pop the duplicate and delete
+                # the live file
+                if base in st["all_model_checkpoint_paths"]:
+                    st["all_model_checkpoint_paths"].remove(base)
                 st["all_model_checkpoint_paths"].append(base)
             st["latest"] = base
             # ring rotation (max_to_keep, saver.py:448 parity)

@@ -108,17 +108,27 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
 
 
 def load_dataset(cfg: TrainConfig):
-    """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts."""
+    """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts.
+
+    Dataset defaults follow the model (BASELINE.json:7-11 pairings):
+    mlp/lenet → MNIST, resnet20 → CIFAR-10, resnet50 → ImageNet.
+    """
     name = cfg.data.dataset
     if name in ("mlp", "mnist", "lenet"):
         from ..data.mnist import get_mnist
+        # arrays stay flat-784; models normalize input shape themselves
+        # (mlp flattens, lenet reshapes to NHWC)
         d = get_mnist(cfg.data.data_dir, cfg.data.synthetic)
-        flat = name != "lenet"
-        def shape(x):
-            return x if flat else x.reshape(-1, 28, 28, 1)
-        return ({"x": shape(d["train_x"]), "y": d["train_y"]},
-                {"x": shape(d["test_x"]), "y": d["test_y"]})
-    raise SystemExit(f"dataset {name!r} not wired into the CLI yet")
+    elif name in ("resnet20", "cifar10", "cifar"):
+        from ..data.cifar import get_cifar10
+        d = get_cifar10(cfg.data.data_dir, cfg.data.synthetic)
+    elif name in ("resnet50", "imagenet"):
+        from ..data.imagenet import get_imagenet
+        d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic)
+    else:
+        raise SystemExit(f"dataset {name!r} not wired into the CLI yet")
+    return ({"x": d["train_x"], "y": d["train_y"]},
+            {"x": d["test_x"], "y": d["test_y"]})
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -147,7 +157,8 @@ def main(argv: list[str] | None = None) -> int:
     trainer = Trainer(model, cfg, train_arrays, eval_arrays,
                       process_index=ctx.process_index if ctx else 0,
                       num_processes=ctx.num_processes if ctx else 1)
-    state, summary = trainer.train()
+    with trainer:
+        state, summary = trainer.train()
 
     # the reference's closing print: final test accuracy (SURVEY.md §2.1)
     if "eval" in summary:

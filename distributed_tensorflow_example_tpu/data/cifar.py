@@ -1,0 +1,67 @@
+"""CIFAR-10: binary-format parser + learnable synthetic fallback.
+
+Real format (the ``cifar-10-batches-bin`` distribution): records of
+1 label byte + 3072 pixel bytes (CHW planar R,G,B, 32x32), 10000 records
+per ``data_batch_N.bin`` / ``test_batch.bin`` file. Output is NHWC float32
+in [0,1] — the TPU-native conv layout.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_REC = 1 + 3072
+_TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+_TEST_FILE = "test_batch.bin"
+
+
+def read_cifar_bin(path: str) -> tuple[np.ndarray, np.ndarray]:
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % _REC:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of "
+                         f"record size {_REC}")
+    raw = raw.reshape(-1, _REC)
+    labels = raw[:, 0].astype(np.int32)
+    # CHW planar → NHWC
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return imgs.astype(np.float32) / 255.0, labels
+
+
+def load_cifar10(data_dir: str) -> dict[str, np.ndarray]:
+    # accept either the dir itself or the standard subdir name
+    sub = os.path.join(data_dir, "cifar-10-batches-bin")
+    root = sub if os.path.isdir(sub) else data_dir
+    xs, ys = [], []
+    for f in _TRAIN_FILES:
+        x, y = read_cifar_bin(os.path.join(root, f))
+        xs.append(x)
+        ys.append(y)
+    tx, ty = np.concatenate(xs), np.concatenate(ys)
+    vx, vy = read_cifar_bin(os.path.join(root, _TEST_FILE))
+    return {"train_x": tx, "train_y": ty, "test_x": vx, "test_y": vy}
+
+
+def synthetic_cifar10(num_train: int = 4096, num_test: int = 512,
+                      seed: int = 0, noise: float = 0.15
+                      ) -> dict[str, np.ndarray]:
+    """Class-conditional color-texture prototypes, 32x32x3 in [0,1]."""
+    rs = np.random.RandomState(seed)
+    protos = rs.rand(10, 32, 32, 3).astype(np.float32) * 0.6 + 0.2
+
+    def draw(n, rstate):
+        y = rstate.randint(0, 10, size=n).astype(np.int32)
+        x = protos[y] + rstate.randn(n, 32, 32, 3).astype(np.float32) * noise
+        return np.clip(x, 0.0, 1.0), y
+
+    tx, ty = draw(num_train, rs)
+    vx, vy = draw(num_test, np.random.RandomState(seed + 1))
+    return {"train_x": tx, "train_y": ty, "test_x": vx, "test_y": vy}
+
+
+def get_cifar10(data_dir: str | None, synthetic: bool = False,
+                **synth_kw) -> dict[str, np.ndarray]:
+    if data_dir and not synthetic:
+        return load_cifar10(data_dir)
+    return synthetic_cifar10(**synth_kw)

@@ -1,0 +1,92 @@
+"""ImageNet: folder-tree loader (PIL) + synthetic fallback.
+
+Real layout: ``<data_dir>/{train,val}/<class_dir>/*.{JPEG,jpg,png}`` with
+class dirs sorted for label assignment (the torchvision convention). Images
+are resized (short side) and center-cropped to ``image_size``. Decoding is
+host-side PIL — adequate for fine-tune-scale runs; the C++ native loader
+path is the place for a decode pipeline if profiling demands it.
+
+Synthetic: ImageNet-shaped (224x224x3, 1000 classes) class-conditional
+textures so ResNet-50 end-to-end runs and benchmarks need no dataset.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_EXTS = (".jpeg", ".jpg", ".png")
+
+
+def _list_classes(split_dir: str) -> list[str]:
+    return sorted(d for d in os.listdir(split_dir)
+                  if os.path.isdir(os.path.join(split_dir, d)))
+
+
+def load_imagenet_folder(data_dir: str, split: str = "train", *,
+                         image_size: int = 224,
+                         max_per_class: int | None = None
+                         ) -> dict[str, np.ndarray]:
+    """Eagerly decodes a folder tree into arrays. Use ``max_per_class`` to
+    bound memory (full ImageNet does not fit in host RAM as float32)."""
+    try:
+        from PIL import Image
+    except ImportError as e:                      # pragma: no cover
+        raise RuntimeError("PIL is required for real ImageNet decoding") from e
+
+    split_dir = os.path.join(data_dir, split)
+    classes = _list_classes(split_dir)
+    if not classes:
+        raise FileNotFoundError(f"no class dirs under {split_dir}")
+    xs, ys = [], []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(split_dir, cls)
+        files = sorted(f for f in os.listdir(cdir)
+                       if f.lower().endswith(_EXTS))
+        if max_per_class:
+            files = files[:max_per_class]
+        for f in files:
+            img = Image.open(os.path.join(cdir, f)).convert("RGB")
+            w, h = img.size
+            scale = image_size / min(w, h)
+            img = img.resize((round(w * scale), round(h * scale)))
+            w, h = img.size
+            left, top = (w - image_size) // 2, (h - image_size) // 2
+            img = img.crop((left, top, left + image_size, top + image_size))
+            xs.append(np.asarray(img, np.float32) / 255.0)
+            ys.append(label)
+    return {f"{split}_x": np.stack(xs),
+            f"{split}_y": np.asarray(ys, np.int32)}
+
+
+def synthetic_imagenet(num_train: int = 512, num_test: int = 128,
+                       num_classes: int = 1000, image_size: int = 224,
+                       seed: int = 0, noise: float = 0.1
+                       ) -> dict[str, np.ndarray]:
+    """ImageNet-shaped synthetic data. Prototypes are low-res textures
+    upsampled to full size (keeps the generator's memory footprint small
+    while remaining class-separable)."""
+    rs = np.random.RandomState(seed)
+    small = rs.rand(num_classes, 16, 16, 3).astype(np.float32)
+    reps = image_size // 16
+
+    def draw(n, rstate):
+        y = rstate.randint(0, num_classes, size=n).astype(np.int32)
+        proto = np.repeat(np.repeat(small[y], reps, axis=1), reps, axis=2)
+        x = proto + rstate.randn(*proto.shape).astype(np.float32) * noise
+        return np.clip(x, 0.0, 1.0), y
+
+    tx, ty = draw(num_train, rs)
+    vx, vy = draw(num_test, np.random.RandomState(seed + 1))
+    return {"train_x": tx, "train_y": ty, "test_x": vx, "test_y": vy}
+
+
+def get_imagenet(data_dir: str | None, synthetic: bool = False,
+                 **synth_kw) -> dict[str, np.ndarray]:
+    if data_dir and not synthetic:
+        train = load_imagenet_folder(data_dir, "train")
+        val = load_imagenet_folder(data_dir, "val")
+        return {"train_x": train["train_x"], "train_y": train["train_y"],
+                "test_x": val["val_x"], "test_y": val["val_y"]}
+    return synthetic_imagenet(**synth_kw)

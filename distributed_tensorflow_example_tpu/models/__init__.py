@@ -3,7 +3,9 @@
 ImageNet ResNet-50, BERT-base MLM.
 """
 
-from .base import Model, get_model, register_model
-from . import mlp as mlp  # registers "mlp"
+from .base import Model, get_model, list_models, register_model
+from . import mlp as mlp          # registers "mlp"
+from . import lenet as lenet      # registers "lenet"
+from . import resnet as resnet    # registers "resnet20", "resnet50"
 
-__all__ = ["Model", "get_model", "register_model"]
+__all__ = ["Model", "get_model", "list_models", "register_model"]

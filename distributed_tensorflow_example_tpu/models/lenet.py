@@ -1,0 +1,80 @@
+"""MNIST LeNet CNN — the reference's second workload config
+(BASELINE.json:8 'MNIST LeNet CNN, 1 PS + 4 workers → 4-chip TPU
+data-parallel').
+
+Classic LeNet shape: conv5x5/32 → maxpool → conv5x5/64 → maxpool →
+fc512 → fc10, NHWC, relu. Convs land on the MXU via XLA's native
+NHWC/HWIO conv lowering (ops/nn.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TrainConfig
+from ..ops import losses, nn
+from .base import DefaultRulesMixin, register_model
+
+
+class LeNet(DefaultRulesMixin):
+    name = "lenet"
+
+    def __init__(self, num_classes: int = 10, dropout_rate: float = 0.0,
+                 dtype=jnp.float32):
+        self.num_classes = num_classes
+        self.dropout_rate = dropout_rate
+        self.dtype = dtype
+
+    def init(self, rng: jax.Array):
+        r = jax.random.split(rng, 4)
+        return {
+            "conv1": nn.conv2d_init(r[0], 5, 5, 1, 32),
+            "conv2": nn.conv2d_init(r[1], 5, 5, 32, 64),
+            "fc1": nn.dense_init(r[2], 7 * 7 * 64, 512, init="he"),
+            "fc2": nn.dense_init(r[3], 512, self.num_classes,
+                                 init="truncated_normal"),
+        }
+
+    def apply(self, params, extras, batch, rng=None, train: bool = False):
+        x = batch["x"]
+        if x.ndim == 2:                       # flat 784 → NHWC
+            x = x.reshape(-1, 28, 28, 1)
+        h = jax.nn.relu(nn.conv2d(params["conv1"], x, dtype=self.dtype))
+        h = nn.max_pool(h, 2, 2)
+        h = jax.nn.relu(nn.conv2d(params["conv2"], h, dtype=self.dtype))
+        h = nn.max_pool(h, 2, 2)
+        h = h.reshape(h.shape[0], -1)
+        h = jax.nn.relu(nn.dense(params["fc1"], h, dtype=self.dtype))
+        if train and self.dropout_rate > 0 and rng is not None:
+            h = nn.dropout(rng, h, self.dropout_rate, train=True)
+        logits = nn.dense(params["fc2"], h, dtype=self.dtype)
+        return logits, extras
+
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng, train=True)
+        loss = losses.softmax_xent_int_labels(logits, batch["y"])
+        aux = {"accuracy": losses.accuracy(logits, batch["y"])}
+        return loss, (aux, new_extras)
+
+    def eval_metrics(self, params, extras, batch) -> dict:
+        logits, _ = self.apply(params, extras, batch, train=False)
+        return {
+            "loss": losses.softmax_xent_int_labels(logits, batch["y"]),
+            "accuracy": losses.accuracy(logits, batch["y"]),
+        }
+
+    def dummy_batch(self, batch_size: int):
+        rs = np.random.RandomState(0)
+        return {
+            "x": rs.rand(batch_size, 28, 28, 1).astype(np.float32),
+            "y": rs.randint(0, self.num_classes, size=(batch_size,),
+                            dtype=np.int32),
+        }
+
+
+@register_model("lenet")
+def _make_lenet(config: TrainConfig) -> LeNet:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    return LeNet(dtype=dtype)

@@ -1,0 +1,226 @@
+"""ResNet family: ResNet-20 (CIFAR-10) and ResNet-50 (ImageNet).
+
+Reference workloads 3 and 4 (BASELINE.json:9-10: 'CIFAR-10 ResNet-20 sync
+SGD on v4-8', 'ImageNet ResNet-50, multi-host TPUStrategy on v4-32').
+
+TPU-first choices: NHWC layout (XLA:TPU native), bf16 compute with f32
+BatchNorm statistics, BatchNorm running stats in ``TrainState.extras``
+(sync-BN semantics fall out of global-batch sharding in the auto step,
+see parallel/sync_replicas.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TrainConfig
+from ..ops import losses, nn
+from .base import DefaultRulesMixin, register_model
+
+
+def _bn_apply(params, extras, x, *, train, momentum=0.9):
+    y, new = nn.batchnorm(params, extras, x.astype(jnp.float32),
+                          train=train, momentum=momentum)
+    return y, new
+
+
+class _BasicBlock:
+    """3x3 + 3x3 with identity/projection shortcut (ResNet-20)."""
+
+    expansion = 1
+
+    @staticmethod
+    def init(rng, in_ch: int, width: int, stride: int):
+        r = jax.random.split(rng, 3)
+        out_ch = width
+        params = {
+            "conv1": nn.conv2d_init(r[0], 3, 3, in_ch, width, use_bias=False),
+            "conv2": nn.conv2d_init(r[1], 3, 3, width, out_ch, use_bias=False),
+        }
+        extras = {}
+        params["bn1"], extras["bn1"] = nn.batchnorm_init(width)
+        params["bn2"], extras["bn2"] = nn.batchnorm_init(out_ch)
+        if stride != 1 or in_ch != out_ch:
+            params["proj"] = nn.conv2d_init(r[2], 1, 1, in_ch, out_ch,
+                                            use_bias=False)
+            params["proj_bn"], extras["proj_bn"] = nn.batchnorm_init(out_ch)
+        return params, extras, out_ch
+
+    @staticmethod
+    def apply(params, extras, x, *, stride, train, dtype):
+        new = {}
+        h = nn.conv2d(params["conv1"], x, stride=stride, dtype=dtype)
+        h, new["bn1"] = _bn_apply(params["bn1"], extras["bn1"], h, train=train)
+        h = jax.nn.relu(h)
+        h = nn.conv2d(params["conv2"], h, dtype=dtype)
+        h, new["bn2"] = _bn_apply(params["bn2"], extras["bn2"], h, train=train)
+        if "proj" in params:
+            s = nn.conv2d(params["proj"], x, stride=stride, dtype=dtype)
+            s, new["proj_bn"] = _bn_apply(params["proj_bn"],
+                                          extras["proj_bn"], s, train=train)
+        else:
+            s = x.astype(h.dtype)
+        return jax.nn.relu(h + s), new
+
+
+class _BottleneckBlock:
+    """1x1 → 3x3 → 1x1(×4) with projection shortcut (ResNet-50)."""
+
+    expansion = 4
+
+    @staticmethod
+    def init(rng, in_ch: int, width: int, stride: int):
+        r = jax.random.split(rng, 4)
+        out_ch = width * 4
+        params = {
+            "conv1": nn.conv2d_init(r[0], 1, 1, in_ch, width, use_bias=False),
+            "conv2": nn.conv2d_init(r[1], 3, 3, width, width, use_bias=False),
+            "conv3": nn.conv2d_init(r[2], 1, 1, width, out_ch, use_bias=False),
+        }
+        extras = {}
+        params["bn1"], extras["bn1"] = nn.batchnorm_init(width)
+        params["bn2"], extras["bn2"] = nn.batchnorm_init(width)
+        params["bn3"], extras["bn3"] = nn.batchnorm_init(out_ch)
+        if stride != 1 or in_ch != out_ch:
+            params["proj"] = nn.conv2d_init(r[3], 1, 1, in_ch, out_ch,
+                                            use_bias=False)
+            params["proj_bn"], extras["proj_bn"] = nn.batchnorm_init(out_ch)
+        return params, extras, out_ch
+
+    @staticmethod
+    def apply(params, extras, x, *, stride, train, dtype):
+        new = {}
+        h = nn.conv2d(params["conv1"], x, dtype=dtype)
+        h, new["bn1"] = _bn_apply(params["bn1"], extras["bn1"], h, train=train)
+        h = jax.nn.relu(h)
+        h = nn.conv2d(params["conv2"], h, stride=stride, dtype=dtype)
+        h, new["bn2"] = _bn_apply(params["bn2"], extras["bn2"], h, train=train)
+        h = jax.nn.relu(h)
+        h = nn.conv2d(params["conv3"], h, dtype=dtype)
+        h, new["bn3"] = _bn_apply(params["bn3"], extras["bn3"], h, train=train)
+        if "proj" in params:
+            s = nn.conv2d(params["proj"], x, stride=stride, dtype=dtype)
+            s, new["proj_bn"] = _bn_apply(params["proj_bn"],
+                                          extras["proj_bn"], s, train=train)
+        else:
+            s = x.astype(h.dtype)
+        return jax.nn.relu(h + s), new
+
+
+class ResNet(DefaultRulesMixin):
+    """Configurable ResNet. Two presets registered below:
+
+    - ``resnet20``: CIFAR stem (3x3/16, no maxpool), basic blocks [3,3,3],
+      widths [16,32,64] — the canonical CIFAR-10 ResNet-20.
+    - ``resnet50``: ImageNet stem (7x7/64 s2 + maxpool), bottlenecks
+      [3,4,6,3], widths [64,128,256,512].
+    """
+
+    def __init__(self, name: str, block, stage_sizes: Sequence[int],
+                 widths: Sequence[int], num_classes: int,
+                 input_hw: int, imagenet_stem: bool, dtype=jnp.float32):
+        self.name = name
+        self.block = block
+        self.stage_sizes = list(stage_sizes)
+        self.widths = list(widths)
+        self.num_classes = num_classes
+        self.input_hw = input_hw
+        self.imagenet_stem = imagenet_stem
+        self.dtype = dtype
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        n_blocks = sum(self.stage_sizes)
+        keys = jax.random.split(rng, n_blocks + 2)
+        ki = iter(range(n_blocks + 2))
+
+        params: dict = {}
+        extras: dict = {}
+        if self.imagenet_stem:
+            params["stem"] = nn.conv2d_init(keys[next(ki)], 7, 7, 3, 64,
+                                            use_bias=False)
+            ch = 64
+        else:
+            params["stem"] = nn.conv2d_init(keys[next(ki)], 3, 3, 3, 16,
+                                            use_bias=False)
+            ch = 16
+        params["stem_bn"], extras["stem_bn"] = nn.batchnorm_init(ch)
+
+        for si, (n, w) in enumerate(zip(self.stage_sizes, self.widths)):
+            for bi in range(n):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                p, e, ch = self.block.init(keys[next(ki)], ch, w, stride)
+                params[f"s{si}b{bi}"] = p
+                extras[f"s{si}b{bi}"] = e
+
+        params["fc"] = nn.dense_init(keys[next(ki)], ch, self.num_classes,
+                                     init="truncated_normal")
+        return params, extras
+
+    # ------------------------------------------------------------------
+    def apply(self, params, extras, batch, rng=None, train: bool = False):
+        x = batch["x"]
+        new: dict = {}
+        h = nn.conv2d(params["stem"], x,
+                      stride=2 if self.imagenet_stem else 1,
+                      dtype=self.dtype)
+        h, new["stem_bn"] = _bn_apply(params["stem_bn"], extras["stem_bn"],
+                                      h, train=train)
+        h = jax.nn.relu(h)
+        if self.imagenet_stem:
+            h = nn.max_pool(h, 3, 2, padding="SAME")
+
+        for si, n in enumerate(self.stage_sizes):
+            for bi in range(n):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                key = f"s{si}b{bi}"
+                h, new[key] = self.block.apply(
+                    params[key], extras[key], h, stride=stride,
+                    train=train, dtype=self.dtype)
+
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))   # global avg pool
+        logits = nn.dense(params["fc"], h, dtype=self.dtype)
+        return logits, (new if train else extras)
+
+    # ------------------------------------------------------------------
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng, train=True)
+        loss = losses.softmax_xent_int_labels(logits, batch["y"])
+        aux = {"accuracy": losses.accuracy(logits, batch["y"])}
+        return loss, (aux, new_extras)
+
+    def eval_metrics(self, params, extras, batch) -> dict:
+        logits, _ = self.apply(params, extras, batch, train=False)
+        return {
+            "loss": losses.softmax_xent_int_labels(logits, batch["y"]),
+            "accuracy": losses.accuracy(logits, batch["y"]),
+        }
+
+    def dummy_batch(self, batch_size: int):
+        rs = np.random.RandomState(0)
+        hw = self.input_hw
+        return {
+            "x": rs.rand(batch_size, hw, hw, 3).astype(np.float32),
+            "y": rs.randint(0, self.num_classes, size=(batch_size,),
+                            dtype=np.int32),
+        }
+
+
+@register_model("resnet20")
+def _make_resnet20(config: TrainConfig) -> ResNet:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    return ResNet("resnet20", _BasicBlock, [3, 3, 3], [16, 32, 64],
+                  num_classes=10, input_hw=32, imagenet_stem=False,
+                  dtype=dtype)
+
+
+@register_model("resnet50")
+def _make_resnet50(config: TrainConfig) -> ResNet:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    return ResNet("resnet50", _BottleneckBlock, [3, 4, 6, 3],
+                  [64, 128, 256, 512], num_classes=1000, input_hw=224,
+                  imagenet_stem=True, dtype=dtype)

@@ -98,10 +98,13 @@ def conv2d(params: Params, x: jax.Array, *, stride: int = 1,
     if dtype is not None:
         x = x.astype(dtype)
         kernel = kernel.astype(dtype)
+    # no preferred_element_type here: the conv VJP transposes with the f32
+    # cotangent against bf16 operands and lax.conv rejects mixed dtypes
+    # (dot_general's VJP handles it, so dense() does use f32 accumulation);
+    # downstream BN recasts activations to f32 immediately
     y = lax.conv_general_dilated(
         x, kernel, window_strides=(stride, stride), padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        preferred_element_type=jnp.float32)
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
     if "bias" in params:
         y = y + params["bias"].astype(y.dtype)
     return y

@@ -189,8 +189,8 @@ class SummaryHook(Hook):
         if metrics is None or not self.wants_metrics(step):
             return
         self.metrics_logger.log({"step": step, **metrics})
-    # note: the MetricsLogger is owned (and closed) by its creator — the
-    # Trainer outlives this hook and may keep logging (eval, re-train)
+    # note: the MetricsLogger is owned by its creator (Trainer.close()
+    # releases it); this hook must not close a logger it was handed
 
 
 class GlobalStepWaiterHook(Hook):

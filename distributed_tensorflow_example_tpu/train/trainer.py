@@ -196,6 +196,18 @@ class Trainer:
         return state, summary
 
     # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Release owned resources (the metrics JSONL handle). The Trainer
+        owns the MetricsLogger — hooks must not close it."""
+        self.metrics_logger.close()
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
     def evaluate(self, state: TrainState,
                  batch_size: int | None = None) -> dict[str, float]:
         """Forward-only metrics over the eval set (the reference's final

@@ -1,0 +1,75 @@
+"""Checkpoint manager semantics (Saver parity, SURVEY.md §3.4/§5.4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+    CheckpointManager, latest_checkpoint, restore_or_init)
+
+
+def _state(v=0.0):
+    return {"w": jnp.full((4,), v), "step": jnp.asarray(7, jnp.int32)}
+
+
+def test_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.5), step=10)
+    out = mgr.restore(_state(0.0), step=10)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.5)
+
+
+def test_max_to_keep_ring(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(_state(float(s)), step=s)
+    assert mgr.all_steps() == [3, 4]
+    assert not os.path.exists(mgr.checkpoint_path(1))
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt-4.npz")
+
+
+def test_resave_same_step_does_not_destroy_ring(tmp_path):
+    """Regression: end-of-run save after a 0-step restore must not create a
+    duplicate ring entry whose rotation deletes the live checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1)
+    mgr.save(_state(1.0), step=100)
+    mgr.save(_state(1.0), step=100)     # the end() re-save
+    assert mgr.latest_step() == 100
+    assert os.path.exists(mgr.checkpoint_path(100))
+    out = mgr.restore(_state(0.0))      # must not raise
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+
+def test_restore_missing_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(_state())
+
+
+def test_restore_shape_mismatch_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"w": jnp.zeros((4,))}, step=1)
+    with pytest.raises(ValueError, match="shape"):
+        mgr.restore({"w": jnp.zeros((5,))}, step=1)
+
+
+def test_restore_or_init_decision(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    state, restored = restore_or_init(mgr, lambda: _state(2.0))
+    assert not restored
+    mgr.save(state, step=1)
+    state2, restored2 = restore_or_init(mgr, lambda: _state(0.0))
+    assert restored2
+    np.testing.assert_allclose(np.asarray(state2["w"]), 2.0)
+
+
+def test_prng_key_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    st = {"rng": jax.random.key(3), "w": jnp.ones(2)}
+    mgr.save(st, step=1)
+    out = mgr.restore({"rng": jax.random.key(0), "w": jnp.zeros(2)}, step=1)
+    assert (jax.random.uniform(out["rng"]) ==
+            jax.random.uniform(jax.random.key(3)))

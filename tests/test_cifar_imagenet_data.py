@@ -1,0 +1,85 @@
+"""CIFAR-10 binary parser + ImageNet folder loader tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.data.cifar import (
+    load_cifar10, read_cifar_bin, synthetic_cifar10)
+from distributed_tensorflow_example_tpu.data.imagenet import (
+    load_imagenet_folder, synthetic_imagenet)
+
+
+def _write_cifar(tmp_path, n=5):
+    """Forge real-format CIFAR binaries."""
+    root = tmp_path / "cifar-10-batches-bin"
+    root.mkdir()
+    rs = np.random.RandomState(0)
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        recs = []
+        for _ in range(n):
+            label = rs.randint(0, 10, dtype=np.uint8)
+            pix = rs.randint(0, 256, size=3072).astype(np.uint8)
+            recs.append(np.concatenate([[label], pix]))
+        np.concatenate(recs).astype(np.uint8).tofile(str(root / name))
+    return root
+
+
+def test_cifar_bin_roundtrip(tmp_path):
+    root = _write_cifar(tmp_path)
+    x, y = read_cifar_bin(str(root / "data_batch_1.bin"))
+    assert x.shape == (5, 32, 32, 3) and x.dtype == np.float32
+    assert x.min() >= 0.0 and x.max() <= 1.0
+    assert y.shape == (5,) and y.dtype == np.int32
+    d = load_cifar10(str(tmp_path))       # finds the subdir itself
+    assert d["train_x"].shape == (25, 32, 32, 3)
+    assert d["test_x"].shape == (5, 32, 32, 3)
+
+
+def test_cifar_bin_bad_size(tmp_path):
+    p = tmp_path / "bad.bin"
+    np.zeros(100, np.uint8).tofile(str(p))
+    with pytest.raises(ValueError, match="record size"):
+        read_cifar_bin(str(p))
+
+
+def test_cifar_channel_order(tmp_path):
+    """First 1024 bytes after the label are the RED plane (CHW planar)."""
+    root = tmp_path
+    rec = np.zeros(3073, np.uint8)
+    rec[0] = 3
+    rec[1:1025] = 255          # red plane
+    rec.tofile(str(root / "one.bin"))
+    x, y = read_cifar_bin(str(root / "one.bin"))
+    assert y[0] == 3
+    np.testing.assert_allclose(x[0, :, :, 0], 1.0)   # R
+    np.testing.assert_allclose(x[0, :, :, 1], 0.0)   # G
+
+
+def test_synthetic_cifar_shapes():
+    d = synthetic_cifar10(num_train=64, num_test=16, seed=1)
+    assert d["train_x"].shape == (64, 32, 32, 3)
+    d2 = synthetic_cifar10(num_train=64, num_test=16, seed=1)
+    np.testing.assert_array_equal(d["train_x"], d2["train_x"])
+
+
+def test_imagenet_folder_loader(tmp_path):
+    from PIL import Image
+    for split in ("train",):
+        for ci, cls in enumerate(["n01", "n02"]):
+            cdir = tmp_path / split / cls
+            cdir.mkdir(parents=True)
+            for j in range(2):
+                arr = np.full((64, 48, 3), (ci * 50 + j * 10) % 255, np.uint8)
+                Image.fromarray(arr).save(str(cdir / f"img{j}.JPEG"))
+    d = load_imagenet_folder(str(tmp_path), "train", image_size=32)
+    assert d["train_x"].shape == (4, 32, 32, 3)
+    assert list(d["train_y"]) == [0, 0, 1, 1]   # sorted class order
+
+
+def test_synthetic_imagenet_shapes():
+    d = synthetic_imagenet(num_train=8, num_test=4, num_classes=10,
+                           image_size=64)
+    assert d["train_x"].shape == (8, 64, 64, 3)
+    assert d["train_x"].min() >= 0.0 and d["train_x"].max() <= 1.0
