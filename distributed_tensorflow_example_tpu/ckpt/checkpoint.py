@@ -170,19 +170,24 @@ class CheckpointManager:
             st = self._state()
             base = os.path.basename(path)
             now = time.time()
-            if (self.keep_every_n_hours > 0 and
-                    now - self._last_kept_forever
-                    >= self.keep_every_n_hours * 3600):
-                if base not in st.get("kept_forever", []):
-                    st.setdefault("kept_forever", []).append(base)
-                self._last_kept_forever = now
+            # a step may only live in ONE list: re-saving an existing step
+            # (end-of-run save after restore, or a ring entry promoted to
+            # kept-forever) must not leave a stale entry behind — ring
+            # rotation would os.remove a file the other list still names
+            if base in st["all_model_checkpoint_paths"]:
+                st["all_model_checkpoint_paths"].remove(base)
+            was_kept = base in st.get("kept_forever", [])
+            if was_kept:
+                st["kept_forever"].remove(base)
+            if was_kept or (self.keep_every_n_hours > 0 and
+                            now - self._last_kept_forever
+                            >= self.keep_every_n_hours * 3600):
+                # once kept-forever, always kept-forever: a re-save must not
+                # demote the step into the ring where rotation deletes it
+                st.setdefault("kept_forever", []).append(base)
+                if not was_kept:
+                    self._last_kept_forever = now
             else:
-                # re-saving an existing step (e.g. end-of-run save after a
-                # restore with no new steps) must not create a duplicate
-                # ring entry — rotation would pop the duplicate and delete
-                # the live file
-                if base in st["all_model_checkpoint_paths"]:
-                    st["all_model_checkpoint_paths"].remove(base)
                 st["all_model_checkpoint_paths"].append(base)
             st["latest"] = base
             # ring rotation (max_to_keep, saver.py:448 parity)

@@ -37,6 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default: the model's canonical dataset")
     p.add_argument("--data_dir", default=None,
                    help="real dataset directory; omit for synthetic data")
+    p.add_argument("--native", action="store_true",
+                   help="use the C++ native loader when built (falls back "
+                        "to the Python loader if unavailable)")
+    p.add_argument("--max_per_class", type=int, default=None,
+                   help="cap eagerly-decoded images per class (ImageNet "
+                        "folder loading; full train split is ~770GB as f32)")
+    p.add_argument("--seq_len", type=int, default=128,
+                   help="BERT sequence length (must be <= model max_len)")
     p.add_argument("--batch_size", type=int, default=128,
                    help="GLOBAL batch size")
     p.add_argument("--train_steps", type=int, default=1000)
@@ -89,7 +97,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
         data=DataConfig(dataset=args.dataset or args.model,
                         data_dir=args.data_dir,
-                        batch_size=args.batch_size, seed=args.seed),
+                        batch_size=args.batch_size, seed=args.seed,
+                        native=args.native, seq_len=args.seq_len,
+                        max_per_class=args.max_per_class),
         optimizer=OptimizerConfig(name=args.optimizer,
                                   learning_rate=args.learning_rate,
                                   total_steps=args.train_steps),
@@ -107,7 +117,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
 
 
-def load_dataset(cfg: TrainConfig):
+def load_dataset(cfg: TrainConfig, model=None):
     """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts.
 
     Dataset defaults follow the model (BASELINE.json:7-11 pairings):
@@ -124,7 +134,31 @@ def load_dataset(cfg: TrainConfig):
         d = get_cifar10(cfg.data.data_dir, cfg.data.synthetic)
     elif name in ("resnet50", "imagenet"):
         from ..data.imagenet import get_imagenet
-        d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic)
+        d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
+                         max_per_class=cfg.data.max_per_class)
+    elif name in ("bert", "bert_tiny"):
+        from ..data.bert_data import get_bert_data
+        # take vocab/prediction shapes from the MODEL so data and logits
+        # can never diverge (out-of-range labels clamp silently under jit)
+        bert_cfg = getattr(model, "cfg", None)
+        vocab = bert_cfg.vocab_size if bert_cfg else cfg.data.vocab_size
+        max_pred = bert_cfg.max_predictions if bert_cfg else 20
+        seq_len = cfg.data.seq_len
+        if bert_cfg and seq_len > bert_cfg.max_len:
+            # positions >= max_len would silently clamp the pos-embedding
+            # gather under jit — same silent-divergence class as vocab
+            raise SystemExit(
+                f"--seq_len {seq_len} exceeds the model's max_len "
+                f"{bert_cfg.max_len}")
+        tr, te = get_bert_data(cfg.data.data_dir, vocab_size=vocab,
+                               seq_len=seq_len, max_predictions=max_pred,
+                               mask_prob=cfg.data.mlm_mask_prob,
+                               synthetic=cfg.data.synthetic)
+        if bert_cfg and tr["input_ids"].shape[1] > bert_cfg.max_len:
+            raise SystemExit(
+                f"dataset sequence length {tr['input_ids'].shape[1]} "
+                f"exceeds the model's max_len {bert_cfg.max_len}")
+        return tr, te
     else:
         raise SystemExit(f"dataset {name!r} not wired into the CLI yet")
     return ({"x": d["train_x"], "y": d["train_y"]},
@@ -152,7 +186,7 @@ def main(argv: list[str] | None = None) -> int:
     from ..train.trainer import Trainer
 
     model = get_model(cfg.model, cfg)
-    train_arrays, eval_arrays = load_dataset(cfg)
+    train_arrays, eval_arrays = load_dataset(cfg, model)
     ctx = server.context
     trainer = Trainer(model, cfg, train_arrays, eval_arrays,
                       process_index=ctx.process_index if ctx else 0,

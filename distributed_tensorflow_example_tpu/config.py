@@ -26,6 +26,9 @@ class DataConfig:
     seed: int = 0
     synthetic: bool = False         # force synthetic data even if data_dir set
     prefetch: int = 2               # host-side prefetch depth
+    native: bool = False            # C++ loader (data/native.py) when built;
+                                    # falls back to Python when unavailable
+    max_per_class: int | None = None  # cap eager folder-tree decode (ImageNet)
     # BERT-only knobs
     seq_len: int = 128
     vocab_size: int = 30522

@@ -1,0 +1,300 @@
+// Native data-loader runtime for distributed_tensorflow_example_tpu.
+//
+// The reference's input machinery was C++ queue runners feeding the graph
+// (SURVEY.md §2.2 'Coordinator/QueueRunner', 'Legacy queue input'); its
+// TPU-native equivalent is this library: worker threads gather example rows
+// into ready-to-feed batch buffers in a bounded ring, overlapping batch
+// assembly with device compute, off the Python GIL.
+//
+// Division of labor with the Python layer (data/native.py):
+//   - Python owns the dataset arrays and the determinism contract: the
+//     per-epoch permutation comes from numpy (identical to the pure-Python
+//     ShardedLoader), so native and Python loaders yield bit-identical
+//     batch sequences.
+//   - C++ owns the bytes: IDX/CIFAR file parsing, permutation-driven row
+//     gather, batch assembly, prefetch ring, thread lifecycle.
+//
+// C API (ctypes-friendly): every function is extern "C"; handles are opaque
+// pointers; errors are negative return codes (no exceptions cross the ABI).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// File parsing: IDX (MNIST) and CIFAR-10 binary
+// ---------------------------------------------------------------------------
+
+static uint32_t be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// Query an IDX image file: fills dims[0..2] = {n, rows, cols}. Returns 0 on
+// success, negative on error.
+int dl_idx_image_dims(const char* path, int64_t dims[3]) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[16];
+  if (fread(hdr, 1, 16, f) != 16) { fclose(f); return -2; }
+  fclose(f);
+  if (be32(hdr) != 2051) return -3;  // image magic
+  dims[0] = be32(hdr + 4);
+  dims[1] = be32(hdr + 8);
+  dims[2] = be32(hdr + 12);
+  return 0;
+}
+
+// Read IDX images into out (n*rows*cols bytes, caller-allocated).
+int dl_idx_read_images(const char* path, unsigned char* out, int64_t out_size) {
+  int64_t dims[3];
+  int rc = dl_idx_image_dims(path, dims);
+  if (rc) return rc;
+  int64_t want = dims[0] * dims[1] * dims[2];
+  if (out_size < want) return -4;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 16, SEEK_SET);
+  int64_t got = (int64_t)fread(out, 1, (size_t)want, f);
+  fclose(f);
+  return got == want ? 0 : -5;
+}
+
+int dl_idx_label_count(const char* path, int64_t* n) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[8];
+  if (fread(hdr, 1, 8, f) != 8) { fclose(f); return -2; }
+  fclose(f);
+  if (be32(hdr) != 2049) return -3;  // label magic
+  *n = be32(hdr + 4);
+  return 0;
+}
+
+int dl_idx_read_labels(const char* path, unsigned char* out, int64_t out_size) {
+  int64_t n;
+  int rc = dl_idx_label_count(path, &n);
+  if (rc) return rc;
+  if (out_size < n) return -4;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 8, SEEK_SET);
+  int64_t got = (int64_t)fread(out, 1, (size_t)n, f);
+  fclose(f);
+  return got == n ? 0 : -5;
+}
+
+// CIFAR-10 binary: records of 1 label byte + 3072 pixel bytes (CHW planar).
+// Parses into NHWC float32 [n,32,32,3] scaled to [0,1] + int32 labels —
+// the exact output of the Python parser, computed here without the
+// transpose/copy chain numpy needs.
+int dl_cifar_record_count(const char* path, int64_t* n) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fclose(f);
+  if (sz % 3073) return -3;
+  *n = sz / 3073;
+  return 0;
+}
+
+int dl_cifar_read(const char* path, float* out_x, int32_t* out_y,
+                  int64_t capacity_records) {
+  int64_t n;
+  int rc = dl_cifar_record_count(path, &n);
+  if (rc) return rc;
+  if (capacity_records < n) return -4;
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  std::vector<unsigned char> rec(3073);
+  const float inv = 1.0f / 255.0f;
+  for (int64_t i = 0; i < n; ++i) {
+    if (fread(rec.data(), 1, 3073, f) != 3073) { fclose(f); return -5; }
+    out_y[i] = rec[0];
+    float* dst = out_x + i * 32 * 32 * 3;
+    const unsigned char* r = rec.data() + 1;
+    const unsigned char* g = r + 1024;
+    const unsigned char* b = g + 1024;
+    for (int p = 0; p < 1024; ++p) {       // CHW planar -> NHWC
+      dst[p * 3 + 0] = r[p] * inv;
+      dst[p * 3 + 1] = g[p] * inv;
+      dst[p * 3 + 2] = b[p] * inv;
+    }
+  }
+  fclose(f);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded batch-assembly ring
+// ---------------------------------------------------------------------------
+
+struct Slot {
+  std::vector<unsigned char> x, y;
+  int64_t seq = -1;               // batch sequence number held in this slot
+  std::atomic<bool> ready{false};
+};
+
+struct DLoader {
+  const unsigned char* x_data;    // borrowed from Python (numpy-owned)
+  const unsigned char* y_data;
+  int64_t row_x, row_y;           // bytes per example row
+  int64_t n_rows;
+  int64_t batch;                  // examples per (local) batch
+  int depth;                      // ring depth
+  int workers;
+
+  std::vector<int64_t> perm;      // current epoch permutation (global order)
+  int64_t n_batches = 0;          // batches per epoch
+
+  std::vector<Slot> slots;
+  std::atomic<int64_t> next_to_fill{0};   // batch seq workers claim
+  int64_t next_to_serve = 0;               // batch seq consumer expects
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> epoch_end{0};      // total batches available so far
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::vector<std::thread> threads;
+
+  void fill(int64_t seq) {
+    Slot& s = slots[seq % depth];
+    const int64_t base = (seq % n_batches) * batch;
+    for (int64_t i = 0; i < batch; ++i) {
+      int64_t src = perm[base + i];
+      memcpy(s.x.data() + i * row_x, x_data + src * row_x, (size_t)row_x);
+      memcpy(s.y.data() + i * row_y, y_data + src * row_y, (size_t)row_y);
+    }
+    {
+      // publish under the lock so a waiter between predicate-check and
+      // wait cannot miss the notify
+      std::lock_guard<std::mutex> lk(mu);
+      s.seq = seq;
+      s.ready.store(true, std::memory_order_release);
+    }
+    cv_ready.notify_all();
+  }
+
+  void worker() {
+    while (!stop.load(std::memory_order_acquire)) {
+      int64_t seq = next_to_fill.load(std::memory_order_relaxed);
+      // claim work only within the released window and ring capacity
+      if (seq >= epoch_end.load(std::memory_order_acquire) ||
+          seq >= next_to_serve_snapshot() + depth) {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait_for(lk, std::chrono::milliseconds(50));
+        continue;
+      }
+      if (!next_to_fill.compare_exchange_strong(seq, seq + 1)) continue;
+      // slot must be free (consumer released it)
+      Slot& s = slots[seq % depth];
+      while (s.ready.load(std::memory_order_acquire) &&
+             !stop.load(std::memory_order_acquire)) {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait_for(lk, std::chrono::milliseconds(50));
+      }
+      if (stop.load(std::memory_order_acquire)) return;
+      fill(seq);
+    }
+  }
+
+  int64_t next_to_serve_snapshot() {
+    std::lock_guard<std::mutex> lk(mu);
+    return next_to_serve;
+  }
+};
+
+// Create a loader over borrowed row-major arrays. local batch only — the
+// process's shard of the global batch; sharding policy stays in Python.
+DLoader* dl_create(const unsigned char* x, int64_t row_x,
+                   const unsigned char* y, int64_t row_y,
+                   int64_t n_rows, int64_t batch, int depth, int workers) {
+  if (!x || !y || batch <= 0 || depth <= 0 || n_rows < batch) return nullptr;
+  auto* L = new DLoader();
+  L->x_data = x; L->y_data = y;
+  L->row_x = row_x; L->row_y = row_y;
+  L->n_rows = n_rows; L->batch = batch;
+  L->depth = depth; L->workers = workers > 0 ? workers : 2;
+  L->slots = std::vector<Slot>(depth);
+  for (auto& s : L->slots) {
+    s.x.resize((size_t)(batch * row_x));
+    s.y.resize((size_t)(batch * row_y));
+  }
+  for (int i = 0; i < L->workers; ++i)
+    L->threads.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+// Install the next epoch's permutation (length must be a multiple of batch;
+// Python truncates to full batches — drop_remainder semantics). Extends the
+// released window by perm_len/batch batches.
+int dl_set_epoch(DLoader* L, const int64_t* perm, int64_t perm_len) {
+  if (!L || perm_len % L->batch) return -1;
+  for (int64_t i = 0; i < perm_len; ++i)
+    if (perm[i] < 0 || perm[i] >= L->n_rows) return -2;
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->perm.assign(perm, perm + perm_len);
+    L->n_batches = perm_len / L->batch;
+    // serving position continues; window extends one epoch
+    L->epoch_end.store(
+        ((L->epoch_end.load() / L->n_batches) + 1) * L->n_batches,
+        std::memory_order_release);
+  }
+  L->cv_free.notify_all();
+  return 0;
+}
+
+// Blocking: acquire pointers to the next assembled batch. Caller must call
+// dl_release before the slot can be refilled. Returns 0, or -1 on shutdown,
+// -2 when no epoch is installed.
+int dl_acquire(DLoader* L, unsigned char** out_x, unsigned char** out_y) {
+  if (!L) return -1;
+  if (L->epoch_end.load() == 0) return -2;
+  Slot& s = L->slots[L->next_to_serve % L->depth];
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->cv_ready.wait(lk, [&] {
+    return L->stop.load() ||
+           (s.ready.load(std::memory_order_acquire) &&
+            s.seq == L->next_to_serve);
+  });
+  if (L->stop.load()) return -1;
+  *out_x = s.x.data();
+  *out_y = s.y.data();
+  return 0;
+}
+
+int dl_release(DLoader* L) {
+  if (!L) return -1;
+  Slot& s = L->slots[L->next_to_serve % L->depth];
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    s.ready.store(false, std::memory_order_release);
+    s.seq = -1;
+    L->next_to_serve += 1;
+  }
+  L->cv_free.notify_all();
+  return 0;
+}
+
+void dl_destroy(DLoader* L) {
+  if (!L) return;
+  L->stop.store(true, std::memory_order_release);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->threads) t.join();
+  delete L;
+}
+
+// Version tag for Python-side compatibility checks.
+int dl_abi_version() { return 1; }
+
+}  // extern "C"

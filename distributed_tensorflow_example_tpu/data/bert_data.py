@@ -1,0 +1,132 @@
+"""BERT MLM data pipeline: masking + synthetic corpus + pre-tokenized files.
+
+Produces the static-shape batch layout BERT-style TPU pretraining uses
+(fixed ``max_predictions`` masked slots per sequence):
+
+    input_ids, token_type_ids, attention_mask: [N, S] int32
+    masked_positions, masked_labels: [N, M] int32; masked_weights: [N, M] f32
+
+Masking follows the canonical BERT recipe: 15% of positions chosen, of
+which 80% → [MASK], 10% → random token, 10% kept. Special ids follow the
+bert-base-uncased convention ([PAD]=0, [CLS]=101, [SEP]=102, [MASK]=103).
+
+Real data: a directory containing ``tokens.npy`` (or ``train.npy`` +
+``test.npy``) of shape [N, S] int32 pre-tokenized sequences — tokenization
+itself is out of scope for the training framework (zero-egress sandboxes
+have no vocab files).
+
+Synthetic corpus: Zipf-distributed tokens with deterministic bigram
+structure, so MLM training has real signal (a masked token is predictable
+from its neighbors) and loss curves behave qualitatively like natural text.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+PAD, CLS, SEP, MASK = 0, 101, 102, 103
+_SPECIALS = (PAD, CLS, SEP, MASK)
+_FIRST_REGULAR = 110            # ids below this are reserved/special
+
+
+def synthetic_corpus(num_seqs: int = 2048, seq_len: int = 128,
+                     vocab_size: int = 30522, seed: int = 0) -> np.ndarray:
+    """[N, S] int32 token sequences with bigram structure: token t is
+    followed by (t*7+11)%V with prob 0.5 else a Zipf draw — masked tokens
+    are partially predictable from context."""
+    rs = np.random.RandomState(seed)
+    v_eff = vocab_size - _FIRST_REGULAR
+
+    def zipf_draw(n):
+        # bounded zipf over the regular-token range
+        z = rs.zipf(1.3, size=n)
+        return (np.minimum(z, v_eff) - 1) + _FIRST_REGULAR
+
+    seqs = np.empty((num_seqs, seq_len), np.int32)
+    seqs[:, 0] = CLS
+    cur = zipf_draw(num_seqs)
+    seqs[:, 1] = cur
+    for j in range(2, seq_len - 1):
+        follow = (cur * 7 + 11) % v_eff + _FIRST_REGULAR
+        fresh = zipf_draw(num_seqs)
+        take = rs.rand(num_seqs) < 0.5
+        cur = np.where(take, follow, fresh).astype(np.int32)
+        seqs[:, j] = cur
+    seqs[:, -1] = SEP
+    return seqs
+
+
+def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
+                      max_predictions: int = 20, mask_prob: float = 0.15,
+                      seed: int = 0) -> dict[str, np.ndarray]:
+    """Canonical BERT masking → static-shape batch arrays."""
+    rs = np.random.RandomState(seed)
+    n, s = seqs.shape
+    m = max_predictions
+
+    input_ids = seqs.copy()
+    positions = np.zeros((n, m), np.int32)
+    labels = np.zeros((n, m), np.int32)
+    weights = np.zeros((n, m), np.float32)
+
+    maskable = ~np.isin(seqs, _SPECIALS)
+    for i in range(n):
+        cand = np.flatnonzero(maskable[i])
+        if len(cand) == 0:      # all-PAD/special row: nothing to predict
+            continue
+        k = min(m, len(cand), max(1, int(round(len(cand) * mask_prob))))
+        chosen = rs.choice(cand, size=k, replace=False)
+        labels[i, :k] = seqs[i, chosen]
+        positions[i, :k] = chosen
+        weights[i, :k] = 1.0
+        r = rs.rand(k)
+        mask_ids = np.where(
+            r < 0.8, MASK,
+            np.where(r < 0.9,
+                     rs.randint(_FIRST_REGULAR, vocab_size, size=k),
+                     seqs[i, chosen]))
+        input_ids[i, chosen] = mask_ids
+
+    return {
+        "input_ids": input_ids.astype(np.int32),
+        "token_type_ids": np.zeros((n, s), np.int32),
+        "attention_mask": (seqs != PAD).astype(np.int32),
+        "masked_positions": positions,
+        "masked_labels": labels,
+        "masked_weights": weights,
+    }
+
+
+def load_tokenized(data_dir: str) -> tuple[np.ndarray, np.ndarray]:
+    """Pre-tokenized [N,S] int32 arrays: train.npy + test.npy, or a single
+    tokens.npy split 95/5."""
+    tr, te = (os.path.join(data_dir, f) for f in ("train.npy", "test.npy"))
+    if os.path.exists(tr) and os.path.exists(te):
+        return np.load(tr).astype(np.int32), np.load(te).astype(np.int32)
+    single = os.path.join(data_dir, "tokens.npy")
+    if os.path.exists(single):
+        toks = np.load(single).astype(np.int32)
+        cut = max(1, int(len(toks) * 0.95))
+        return toks[:cut], toks[cut:]
+    raise FileNotFoundError(
+        f"no train.npy/test.npy or tokens.npy under {data_dir!r}")
+
+
+def get_bert_data(data_dir: str | None, *, vocab_size: int = 30522,
+                  seq_len: int = 128, max_predictions: int = 20,
+                  mask_prob: float = 0.15, synthetic: bool = False,
+                  num_train: int = 2048, num_test: int = 256,
+                  seed: int = 0) -> tuple[dict, dict]:
+    """Returns (train_arrays, eval_arrays) in the framework batch layout."""
+    if data_dir and not synthetic:
+        train_seqs, test_seqs = load_tokenized(data_dir)
+    else:
+        train_seqs = synthetic_corpus(num_train, seq_len, vocab_size, seed)
+        test_seqs = synthetic_corpus(num_test, seq_len, vocab_size,
+                                     seed + 1)
+    kw = dict(vocab_size=vocab_size, max_predictions=max_predictions,
+              mask_prob=mask_prob)
+    return (apply_mlm_masking(train_seqs, seed=seed + 2, **kw),
+            apply_mlm_masking(test_seqs, seed=seed + 3, **kw))

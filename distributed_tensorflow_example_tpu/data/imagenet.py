@@ -83,10 +83,18 @@ def synthetic_imagenet(num_train: int = 512, num_test: int = 128,
 
 
 def get_imagenet(data_dir: str | None, synthetic: bool = False,
+                 max_per_class: int | None = None,
                  **synth_kw) -> dict[str, np.ndarray]:
+    """``max_per_class`` bounds the eager decode — full ImageNet as float32
+    host arrays is ~770 GB, so pass a bound (CLI: ``--max_per_class``) for
+    anything beyond fine-tune scale. No silent default cap: truncating the
+    dataset without the user asking would corrupt accuracy comparisons. A
+    streaming decode path belongs to the native loader."""
     if data_dir and not synthetic:
-        train = load_imagenet_folder(data_dir, "train")
-        val = load_imagenet_folder(data_dir, "val")
+        train = load_imagenet_folder(data_dir, "train",
+                                     max_per_class=max_per_class)
+        val = load_imagenet_folder(data_dir, "val",
+                                   max_per_class=max_per_class)
         return {"train_x": train["train_x"], "train_y": train["train_y"],
                 "test_x": val["val_x"], "test_y": val["val_y"]}
     return synthetic_imagenet(**synth_kw)

@@ -122,7 +122,16 @@ class PrefetchIterator:
 
 
 def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
-                **kw) -> Iterator[Batch]:
+                native: bool = False, **kw) -> Iterator[Batch]:
+    """Build a batch iterator. ``native=True`` uses the C++ loader
+    (data/native.py) when the library is available and the batch layout is
+    the two-array (x, y) kind; otherwise silently falls back to the Python
+    path — both yield bit-identical batch sequences."""
+    if native and len(arrays) == 2:
+        from . import native as native_mod
+        if native_mod.available():
+            kw.pop("drop_remainder", None)   # native is always drop_remainder
+            return iter(native_mod.NativeLoader(arrays, global_batch, **kw))
     loader = ShardedLoader(arrays, global_batch, **kw)
     it = iter(loader)
     return PrefetchIterator(it, prefetch) if prefetch > 0 else it

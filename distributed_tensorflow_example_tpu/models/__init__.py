@@ -7,5 +7,6 @@ from .base import Model, get_model, list_models, register_model
 from . import mlp as mlp          # registers "mlp"
 from . import lenet as lenet      # registers "lenet"
 from . import resnet as resnet    # registers "resnet20", "resnet50"
+from . import bert as bert        # registers "bert", "bert_tiny"
 
 __all__ = ["Model", "get_model", "list_models", "register_model"]

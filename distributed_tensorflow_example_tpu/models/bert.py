@@ -1,0 +1,272 @@
+"""BERT-base masked-LM — reference workload 5 (BASELINE.json:11:
+'BERT-base MLM fine-tune (large embedding all-reduce over ICI)').
+
+Architecture: post-LN BERT (embeddings: word+position+type → LN; N layers
+of MHA→add&LN→FFN(gelu)→add&LN; MLM head: dense→gelu→LN→ tied-embedding
+decoder + bias). MLM logits are computed only at the masked positions
+(static ``max_predictions`` count, the standard TPU-friendly BERT
+pretraining layout) so the [B,S,V] logit tensor never materializes.
+
+TPU-first design:
+
+- bf16 matmuls with f32 softmax/LN; static shapes throughout.
+- tensor parallelism via sharding rules (``sharding_rules``): QKV and FFN-in
+  kernels split column-wise over ``model``, attention-out and FFN-out
+  row-wise (the Megatron layout — one all-reduce per block), word
+  embeddings vocab-sharded so the tied decoder matmul and its
+  "large embedding all-reduce" ride ICI.
+- sequence parallelism: pass ``attention_fn=make_ring_attention(mesh)``
+  to shard attention over the ``seq`` axis (parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..config import TrainConfig
+from ..ops import losses, nn
+from ..ops.attention import multi_head_attention
+from ..parallel.mesh import AxisNames
+from ..parallel.sharding import ShardingRules
+from .base import register_model
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    intermediate: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    max_predictions: int = 20     # masked positions per sequence (static)
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        """2-layer test-size config (fast CPU compile)."""
+        return cls(vocab_size=1000, hidden=128, layers=2, heads=4,
+                   intermediate=256, max_len=128, max_predictions=8)
+
+
+class Bert:
+    name = "bert"
+
+    def __init__(self, cfg: BertConfig, dtype=jnp.float32,
+                 attention_impl: str = "xla",
+                 attention_fn: Callable | None = None):
+        assert cfg.hidden % cfg.heads == 0
+        self.cfg = cfg
+        self.dtype = dtype
+        self.attention_impl = attention_impl
+        # override hook: e.g. make_ring_attention(mesh) for seq parallelism
+        self.attention_fn = attention_fn
+        self.head_dim = cfg.hidden // cfg.heads
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        c = self.cfg
+        n_keys = 4 + c.layers * 6 + 2
+        keys = iter(jax.random.split(rng, n_keys))
+        params: dict = {
+            "embed": {
+                "word": nn.embedding_init(next(keys), c.vocab_size, c.hidden),
+                "pos": nn.embedding_init(next(keys), c.max_len, c.hidden),
+                "type": nn.embedding_init(next(keys), c.type_vocab, c.hidden),
+            },
+            "embed_ln": nn.layernorm_init(c.hidden),
+        }
+        for i in range(c.layers):
+            params[f"layer_{i}"] = {
+                "attn": {
+                    "q": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                    "k": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                    "v": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                    "o": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                },
+                "attn_ln": nn.layernorm_init(c.hidden),
+                "ffn": {
+                    "in": nn.dense_init(next(keys), c.hidden, c.intermediate,
+                                        init="glorot"),
+                    "out": nn.dense_init(next(keys), c.intermediate,
+                                         c.hidden, init="glorot"),
+                },
+                "ffn_ln": nn.layernorm_init(c.hidden),
+            }
+        params["mlm"] = {
+            "transform": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+            "ln": nn.layernorm_init(c.hidden),
+            # decoder kernel is TIED to embed/word/table; only a bias here
+            "bias": jnp.zeros((c.vocab_size,), jnp.float32),
+        }
+        return params
+
+    # ------------------------------------------------------------------
+    def _attend(self, p, h, mask, rng, train):
+        c = self.cfg
+        b, s, _ = h.shape
+        heads = c.heads
+
+        def split(x):
+            return x.reshape(b, s, heads, self.head_dim)
+
+        q = split(nn.dense(p["q"], h, dtype=self.dtype))
+        k = split(nn.dense(p["k"], h, dtype=self.dtype))
+        v = split(nn.dense(p["v"], h, dtype=self.dtype))
+        if self.attention_fn is not None:
+            ctx = self.attention_fn(q, k, v, mask=mask)
+        else:
+            ctx = multi_head_attention(
+                q, k, v, mask=mask[:, None, None, :],
+                impl=self.attention_impl)
+        ctx = ctx.reshape(b, s, c.hidden)
+        return nn.dense(p["o"], ctx, dtype=self.dtype)
+
+    def encode(self, params, batch, rng=None, train: bool = False):
+        """[B,S] ids -> [B,S,hidden] sequence output."""
+        c = self.cfg
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        types = batch.get("token_type_ids",
+                          jnp.zeros_like(ids))
+        mask = batch.get("attention_mask", jnp.ones_like(ids))
+
+        h = (nn.embedding(params["embed"]["word"], ids)
+             + nn.embedding(params["embed"]["pos"],
+                            jnp.arange(s, dtype=jnp.int32))[None]
+             + nn.embedding(params["embed"]["type"], types))
+        h = nn.layernorm(params["embed_ln"], h.astype(jnp.float32))
+        # dropout requires randomness: rng=None (forward-only callers)
+        # deterministically disables it rather than crashing in fold_in
+        use_dropout = train and c.dropout > 0 and rng is not None
+        if use_dropout:
+            h = nn.dropout(jax.random.fold_in(rng, 1000), h, c.dropout,
+                           train=True)
+
+        for i in range(c.layers):
+            lp = params[f"layer_{i}"]
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            a = self._attend(lp["attn"], h.astype(self.dtype), mask,
+                             lrng, train)
+            if use_dropout:
+                a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
+                               train=True)
+            h = nn.layernorm(lp["attn_ln"],
+                             (h + a.astype(jnp.float32)))
+            f = nn.dense(lp["ffn"]["in"], h.astype(self.dtype),
+                         dtype=self.dtype)
+            f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+            f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
+            if use_dropout:
+                f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
+                               train=True)
+            h = nn.layernorm(lp["ffn_ln"], (h + f.astype(jnp.float32)))
+        return h
+
+    def mlm_logits(self, params, seq_out, masked_positions):
+        """Gather masked positions and decode against the tied embedding.
+        [B,S,hid] + [B,M] -> [B,M,vocab]."""
+        h = jnp.take_along_axis(seq_out, masked_positions[..., None], axis=1)
+        h = nn.dense(params["mlm"]["transform"], h.astype(self.dtype),
+                     dtype=self.dtype)
+        h = jax.nn.gelu(h.astype(jnp.float32))
+        h = nn.layernorm(params["mlm"]["ln"], h)
+        table = params["embed"]["word"]["table"]   # tied decoder
+        logits = jnp.einsum("bmh,vh->bmv", h.astype(self.dtype),
+                            table.astype(self.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits + params["mlm"]["bias"]
+
+    # ------------------------------------------------------------------
+    def apply(self, params, extras, batch, rng=None, train: bool = False):
+        seq_out = self.encode(params, batch, rng, train)
+        logits = self.mlm_logits(params, seq_out, batch["masked_positions"])
+        return logits, extras
+
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng,
+                                        train=True)
+        w = batch["masked_weights"].astype(jnp.float32)
+        loss = losses.softmax_xent_int_labels(
+            logits, batch["masked_labels"], where=w)
+        pred = jnp.argmax(logits, axis=-1)
+        acc = (jnp.sum((pred == batch["masked_labels"]) * w)
+               / jnp.maximum(jnp.sum(w), 1.0))
+        return loss, ({"mlm_accuracy": acc}, new_extras)
+
+    def eval_metrics(self, params, extras, batch) -> dict:
+        logits, _ = self.apply(params, extras, batch, train=False)
+        w = batch["masked_weights"].astype(jnp.float32)
+        pred = jnp.argmax(logits, axis=-1)
+        return {
+            "loss": losses.softmax_xent_int_labels(
+                logits, batch["masked_labels"], where=w),
+            "mlm_accuracy": (jnp.sum((pred == batch["masked_labels"]) * w)
+                             / jnp.maximum(jnp.sum(w), 1.0)),
+        }
+
+    # ------------------------------------------------------------------
+    def sharding_rules(self, mesh_shape) -> ShardingRules:
+        """Megatron-style TP + vocab-sharded embeddings; fsdp fallback."""
+        M = AxisNames.MODEL
+        fsdp = getattr(mesh_shape, "fsdp", 1) if mesh_shape else 1
+        tp = getattr(mesh_shape, "model", 1) if mesh_shape else 1
+        if tp <= 1:
+            return ShardingRules(fsdp_axis_size=fsdp)
+        return ShardingRules(rules=[
+            (r"attn/(q|k|v)/kernel", P(None, M)),
+            (r"attn/(q|k|v)/bias", P(M)),
+            (r"attn/o/kernel", P(M, None)),
+            (r"ffn/in/kernel", P(None, M)),
+            (r"ffn/in/bias", P(M)),
+            (r"ffn/out/kernel", P(M, None)),
+            (r"embed/word/table", P(M, None)),    # vocab-sharded
+            (r"mlm/bias", P(M)),
+        ], fsdp_axis_size=fsdp)
+
+    def dummy_batch(self, batch_size: int):
+        c = self.cfg
+        rs = np.random.RandomState(0)
+        s = min(128, c.max_len)
+        m = c.max_predictions
+        return {
+            "input_ids": rs.randint(0, c.vocab_size, (batch_size, s),
+                                    dtype=np.int32),
+            "token_type_ids": np.zeros((batch_size, s), np.int32),
+            "attention_mask": np.ones((batch_size, s), np.int32),
+            "masked_positions": np.tile(np.arange(m, dtype=np.int32),
+                                        (batch_size, 1)),
+            "masked_labels": rs.randint(0, c.vocab_size, (batch_size, m),
+                                        dtype=np.int32),
+            "masked_weights": np.ones((batch_size, m), np.float32),
+        }
+
+
+@register_model("bert")
+def _make_bert(config: TrainConfig) -> Bert:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    cfg = BertConfig.base()
+    cfg.vocab_size = config.data.vocab_size
+    return Bert(cfg, dtype=dtype)
+
+
+@register_model("bert_tiny")
+def _make_bert_tiny(config: TrainConfig) -> Bert:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    return Bert(BertConfig.tiny(), dtype=dtype)

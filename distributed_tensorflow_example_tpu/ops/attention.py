@@ -1,0 +1,77 @@
+"""Multi-head attention with pluggable implementations.
+
+No attention exists in the reference (MLP/CNN era — SURVEY.md §5.7); this
+op exists because the framework treats long-context/transformer workloads
+as first-class (BERT-base is reference workload 5, BASELINE.json:11).
+
+Implementations:
+
+- ``impl="xla"``: plain jnp einsum chain — XLA fuses it well at BERT-base
+  scale; softmax in f32 for bf16 stability.
+- ``impl="flash"``: Pallas blocked flash-attention kernel
+  (:mod:`.pallas.flash_attention`) — O(S) memory, for long sequences.
+- ring/context-parallel attention lives in
+  :mod:`~distributed_tensorflow_example_tpu.parallel.ring_attention` and
+  reuses these per-block primitives.
+
+Shape convention: [batch, seq, heads, head_dim] (BSHD) throughout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+# mask fill value: large negative but finite, so online-softmax recurrences
+# (ring/flash) can compute exp(NEG_INF - NEG_INF) paths without inf-inf=nan;
+# far enough below any real score that exp underflows to exactly 0
+NEG_INF = -1e30
+
+
+def attention_scores(q: jax.Array, k: jax.Array) -> jax.Array:
+    """[B,Sq,H,D] x [B,Sk,H,D] -> [B,H,Sq,Sk] scaled scores (f32)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32)
+    return s / math.sqrt(d)
+
+
+def apply_mask(scores: jax.Array, mask: jax.Array | None,
+               *, causal: bool = False,
+               q_offset: int | jax.Array = 0,
+               k_offset: int | jax.Array = 0) -> jax.Array:
+    """mask: broadcastable to [B,1,1,Sk] (1 = attend). Causal uses global
+    position offsets so sequence-sharded blocks (ring attention) mask
+    correctly. Single source of truth for score masking — the ring and
+    flash paths reuse this."""
+    neg = jnp.asarray(NEG_INF, scores.dtype)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, neg)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_offset
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + k_offset
+        scores = jnp.where(qpos >= kpos, scores, neg)
+    return scores
+
+
+def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         mask: jax.Array | None = None,
+                         causal: bool = False,
+                         impl: str = "xla") -> jax.Array:
+    """[B,S,H,D] qkv -> [B,S,H,D] context. Softmax in f32."""
+    if impl == "flash":
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, mask=mask, causal=causal)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    scores = attention_scores(q, k)
+    scores = apply_mask(scores, mask, causal=causal)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(v.dtype)

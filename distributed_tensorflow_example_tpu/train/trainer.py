@@ -135,6 +135,7 @@ class Trainer:
         return make_loader(
             self.train_arrays, self.config.data.batch_size,
             prefetch=self.config.data.prefetch,
+            native=self.config.data.native,
             process_index=self.process_index,
             num_processes=self.num_processes,
             shuffle=self.config.data.shuffle,

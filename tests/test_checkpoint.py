@@ -43,6 +43,23 @@ def test_resave_same_step_does_not_destroy_ring(tmp_path):
     np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
 
 
+def test_resave_never_demotes_kept_forever(tmp_path):
+    """Regression: re-saving a kept-forever step must not move it into the
+    ring where rotation would delete it."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=1,
+                            keep_every_n_hours=1.0)
+    mgr._last_kept_forever = 0.0          # force promotion on first save
+    mgr.save(_state(1.0), step=100)       # -> kept_forever
+    mgr.save(_state(1.0), step=100)       # re-save: interval NOT elapsed
+    for s in (101, 102):
+        mgr.save(_state(2.0), step=s)     # rotate the ring
+    assert os.path.exists(mgr.checkpoint_path(100)), \
+        "kept-forever checkpoint was deleted by ring rotation"
+    st = mgr._state()
+    assert "ckpt-100.npz" in st["kept_forever"]
+    assert st["kept_forever"].count("ckpt-100.npz") == 1
+
+
 def test_restore_missing_raises(tmp_path):
     mgr = CheckpointManager(str(tmp_path))
     with pytest.raises(FileNotFoundError):
