@@ -58,6 +58,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "devices on the data axis)")
     p.add_argument("--sync_mode", default="auto",
                    choices=["auto", "shard_map"])
+    p.add_argument("--attention", default="xla", choices=["xla", "flash"],
+                   help="attention implementation for transformer models "
+                        "(flash = Pallas kernel, wins at long sequences)")
     p.add_argument("--ckpt_dir", default=None)
     p.add_argument("--save_steps", type=int, default=0)
     p.add_argument("--save_secs", type=float, default=0.0)
@@ -94,6 +97,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         eval_every_steps=args.eval_every_steps,
         seed=args.seed,
         dtype=args.dtype,
+        attention_impl=args.attention,
         mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
         data=DataConfig(dataset=args.dataset or args.model,
                         data_dir=args.data_dir,

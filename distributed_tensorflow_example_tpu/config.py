@@ -133,6 +133,7 @@ class TrainConfig:
     seed: int = 0
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     param_dtype: str = "float32"
+    attention_impl: str = "xla"      # xla | flash (pallas kernel; long-seq)
 
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -66,28 +66,33 @@ def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
     n, s = seqs.shape
     m = max_predictions
 
-    input_ids = seqs.copy()
-    positions = np.zeros((n, m), np.int32)
-    labels = np.zeros((n, m), np.int32)
-    weights = np.zeros((n, m), np.float32)
-
+    # fully vectorized (a per-row Python loop is a minutes-long startup
+    # wall at pretraining scale): draw a random key per position, push
+    # non-maskable positions to the back, take each row's first k sorted
     maskable = ~np.isin(seqs, _SPECIALS)
-    for i in range(n):
-        cand = np.flatnonzero(maskable[i])
-        if len(cand) == 0:      # all-PAD/special row: nothing to predict
-            continue
-        k = min(m, len(cand), max(1, int(round(len(cand) * mask_prob))))
-        chosen = rs.choice(cand, size=k, replace=False)
-        labels[i, :k] = seqs[i, chosen]
-        positions[i, :k] = chosen
-        weights[i, :k] = 1.0
-        r = rs.rand(k)
-        mask_ids = np.where(
-            r < 0.8, MASK,
-            np.where(r < 0.9,
-                     rs.randint(_FIRST_REGULAR, vocab_size, size=k),
-                     seqs[i, chosen]))
-        input_ids[i, chosen] = mask_ids
+    cand_counts = maskable.sum(axis=1)
+    k = np.minimum.reduce([
+        np.full(n, m),
+        cand_counts,
+        np.maximum(1, np.round(cand_counts * mask_prob).astype(np.int64)),
+    ])
+    k = np.where(cand_counts == 0, 0, k)      # all-PAD rows: no predictions
+
+    keys = rs.rand(n, s) + np.where(maskable, 0.0, 10.0)
+    order = np.argsort(keys, axis=1)[:, :m].astype(np.int32)   # [n, m]
+    sel = np.arange(m)[None, :] < k[:, None]                    # validity
+    positions = np.where(sel, order, 0).astype(np.int32)
+    orig = np.take_along_axis(seqs, positions, axis=1)
+    labels = np.where(sel, orig, 0).astype(np.int32)
+    weights = sel.astype(np.float32)
+
+    decide = rs.rand(n, m)
+    rand_tok = rs.randint(_FIRST_REGULAR, vocab_size, size=(n, m))
+    new_tok = np.where(decide < 0.8, MASK,
+                       np.where(decide < 0.9, rand_tok, orig)).astype(np.int32)
+    input_ids = seqs.copy()
+    rows = np.broadcast_to(np.arange(n)[:, None], (n, m))[sel]
+    input_ids[rows, positions[sel]] = new_tok[sel]
 
     return {
         "input_ids": input_ids.astype(np.int32),
@@ -122,6 +127,16 @@ def get_bert_data(data_dir: str | None, *, vocab_size: int = 30522,
     """Returns (train_arrays, eval_arrays) in the framework batch layout."""
     if data_dir and not synthetic:
         train_seqs, test_seqs = load_tokenized(data_dir)
+        if train_seqs.shape[1] > seq_len:
+            # honor the requested sequence length on real data too — running
+            # at the file's full length would be a silently different
+            # (quadratically costlier) workload than the user asked for
+            import logging
+            logging.getLogger("dtx.data").warning(
+                "truncating pre-tokenized sequences from %d to seq_len=%d",
+                train_seqs.shape[1], seq_len)
+            train_seqs = train_seqs[:, :seq_len]
+            test_seqs = test_seqs[:, :seq_len]
     else:
         train_seqs = synthetic_corpus(num_train, seq_len, vocab_size, seed)
         test_seqs = synthetic_corpus(num_test, seq_len, vocab_size,

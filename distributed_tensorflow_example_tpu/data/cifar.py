@@ -29,17 +29,30 @@ def read_cifar_bin(path: str) -> tuple[np.ndarray, np.ndarray]:
     return imgs.astype(np.float32) / 255.0, labels
 
 
+def _reader():
+    """Prefer the C++ parser (does the CHW→NHWC conversion natively); fall
+    back to the numpy implementation. Identical outputs (tested)."""
+    try:
+        from . import native
+        if native.available():
+            return native.read_cifar_bin
+    except Exception:
+        pass
+    return read_cifar_bin
+
+
 def load_cifar10(data_dir: str) -> dict[str, np.ndarray]:
     # accept either the dir itself or the standard subdir name
     sub = os.path.join(data_dir, "cifar-10-batches-bin")
     root = sub if os.path.isdir(sub) else data_dir
+    read = _reader()
     xs, ys = [], []
     for f in _TRAIN_FILES:
-        x, y = read_cifar_bin(os.path.join(root, f))
+        x, y = read(os.path.join(root, f))
         xs.append(x)
         ys.append(y)
     tx, ty = np.concatenate(xs), np.concatenate(ys)
-    vx, vy = read_cifar_bin(os.path.join(root, _TEST_FILE))
+    vx, vy = read(os.path.join(root, _TEST_FILE))
     return {"train_x": tx, "train_y": ty, "test_x": vx, "test_y": vy}
 
 

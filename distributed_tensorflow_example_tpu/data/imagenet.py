@@ -93,8 +93,9 @@ def get_imagenet(data_dir: str | None, synthetic: bool = False,
     if data_dir and not synthetic:
         train = load_imagenet_folder(data_dir, "train",
                                      max_per_class=max_per_class)
-        val = load_imagenet_folder(data_dir, "val",
-                                   max_per_class=max_per_class)
+        # never cap val: eval numbers must be comparable across runs with
+        # different train caps (val is ~50/class — no memory pressure)
+        val = load_imagenet_folder(data_dir, "val")
         return {"train_x": train["train_x"], "train_y": train["train_y"],
                 "test_x": val["val_x"], "test_y": val["val_y"]}
     return synthetic_imagenet(**synth_kw)

@@ -46,12 +46,28 @@ def read_idx_labels(path: str) -> np.ndarray:
     return np.frombuffer(buf, np.uint8)
 
 
+def _reader_pair(path: str):
+    """Prefer the C++ parsers (data/native.py) for plain files; gzip and
+    native-unavailable fall back to the numpy parsers above. Both return
+    identical arrays (asserted in tests/test_native_loader.py)."""
+    if os.path.exists(path):            # plain (non-.gz) file
+        try:
+            from . import native
+            if native.available():
+                return native.read_idx_images, native.read_idx_labels
+        except Exception:
+            pass
+    return read_idx_images, read_idx_labels
+
+
 def load_mnist(data_dir: str) -> dict[str, np.ndarray]:
     """Returns {'train_x','train_y','test_x','test_y'}; x in [0,1] f32
     flattened to 784 (the reference's input shape), y int32."""
     def split(img, lbl):
-        x = read_idx_images(os.path.join(data_dir, img))
-        y = read_idx_labels(os.path.join(data_dir, lbl))
+        ip = os.path.join(data_dir, img)
+        read_imgs, read_lbls = _reader_pair(ip)
+        x = read_imgs(ip)
+        y = read_lbls(os.path.join(data_dir, lbl))
         return (x.reshape(len(x), -1).astype(np.float32) / 255.0,
                 y.astype(np.int32))
 

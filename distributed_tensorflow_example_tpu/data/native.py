@@ -70,6 +70,18 @@ def _load() -> ctypes.CDLL | None:
                   "dl_idx_label_count", "dl_idx_read_labels",
                   "dl_cifar_record_count", "dl_cifar_read"):
             getattr(lib, f).restype = ctypes.c_int
+        # int64 sizes must be declared: ctypes' default c_int conversion
+        # would truncate >=2GiB payloads on the SysV ABI
+        lib.dl_idx_image_dims.argtypes = [ctypes.c_char_p, ctypes.c_void_p]
+        lib.dl_idx_read_images.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                           ctypes.c_int64]
+        lib.dl_idx_label_count.argtypes = [ctypes.c_char_p, ctypes.c_void_p]
+        lib.dl_idx_read_labels.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                           ctypes.c_int64]
+        lib.dl_cifar_record_count.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_void_p]
+        lib.dl_cifar_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                      ctypes.c_void_p, ctypes.c_int64]
         _lib = lib
         return _lib
 

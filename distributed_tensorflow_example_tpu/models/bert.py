@@ -263,10 +263,11 @@ def _make_bert(config: TrainConfig) -> Bert:
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     cfg = BertConfig.base()
     cfg.vocab_size = config.data.vocab_size
-    return Bert(cfg, dtype=dtype)
+    return Bert(cfg, dtype=dtype, attention_impl=config.attention_impl)
 
 
 @register_model("bert_tiny")
 def _make_bert_tiny(config: TrainConfig) -> Bert:
     dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-    return Bert(BertConfig.tiny(), dtype=dtype)
+    return Bert(BertConfig.tiny(), dtype=dtype,
+                attention_impl=config.attention_impl)

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+XLA's fusion covers most of this framework's compute; kernels live here
+only where manual scheduling wins: flash attention (O(S) memory via online
+softmax, blocked HBM→VMEM movement). See /opt/skills/guides/pallas_guide.md
+for the kernel playbook this follows.
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

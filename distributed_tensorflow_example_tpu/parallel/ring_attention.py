@@ -101,11 +101,23 @@ def make_ring_attention(mesh: Mesh, *, causal: bool = False,
     """
     qkv_spec = P(batch_axes, seq_axis, None, None)
     mask_spec = P(batch_axes, seq_axis)
+    bound_causal = causal
 
-    def attn(q, k, v, *, mask=None, **_):
+    def attn(q, k, v, *, mask=None, causal=None, **unexpected):
+        if unexpected:
+            raise TypeError(f"unexpected kwargs {sorted(unexpected)}; "
+                            "bind options at make_ring_attention() time")
+        if causal is not None and causal != bound_causal:
+            # silently ignoring a call-site causal flag would run
+            # bidirectional attention in a decoder — fail loudly instead
+            raise ValueError(
+                f"causal={causal} at call time conflicts with "
+                f"make_ring_attention(causal={bound_causal}); causality is "
+                "baked into the ring schedule and must be bound at "
+                "construction")
         if mask is not None:
             fn = partial(ring_attention_local, axis_name=seq_axis,
-                         causal=causal)
+                         causal=bound_causal)
             sharded = jax.shard_map(
                 lambda q_, k_, v_, m_: fn(q_, k_, v_, kv_mask=m_),
                 mesh=mesh,
@@ -114,7 +126,8 @@ def make_ring_attention(mesh: Mesh, *, causal: bool = False,
             return sharded(q, k, v, mask)
         sharded = jax.shard_map(
             lambda q_, k_, v_: ring_attention_local(
-                q_, k_, v_, axis_name=seq_axis, causal=causal, kv_mask=None),
+                q_, k_, v_, axis_name=seq_axis, causal=bound_causal,
+                kv_mask=None),
             mesh=mesh, in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec, check_vma=False)
         return sharded(q, k, v)
