@@ -122,16 +122,39 @@ class PrefetchIterator:
 
 
 def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
-                native: bool = False, **kw) -> Iterator[Batch]:
+                native: bool = False, start_step: int = 0,
+                **kw) -> Iterator[Batch]:
     """Build a batch iterator. ``native=True`` uses the C++ loader
     (data/native.py) when the library is available and the batch layout is
     the two-array (x, y) kind; otherwise silently falls back to the Python
-    path — both yield bit-identical batch sequences."""
+    path — both yield bit-identical batch sequences.
+
+    ``start_step`` fast-forwards the deterministic batch sequence so a
+    restored run consumes exactly the batches an uninterrupted run would
+    have (exact-resume semantics; the restore-or-init story of SURVEY.md
+    §3.5 extends to the data stream). Epoch seeding makes the skip cheap:
+    only the current epoch's prefix is discarded.
+    """
+    loader: ShardedLoader | None = None
     if native and len(arrays) == 2:
         from . import native as native_mod
         if native_mod.available():
             kw.pop("drop_remainder", None)   # native is always drop_remainder
-            return iter(native_mod.NativeLoader(arrays, global_batch, **kw))
+            nat = native_mod.NativeLoader(arrays, global_batch, **kw)
+            it = _fast_forward(nat, iter(nat), start_step)
+            return it
     loader = ShardedLoader(arrays, global_batch, **kw)
-    it = iter(loader)
+    it = _fast_forward(loader, iter(loader), start_step)
     return PrefetchIterator(it, prefetch) if prefetch > 0 else it
+
+
+def _fast_forward(loader, it: Iterator[Batch], start_step: int
+                  ) -> Iterator[Batch]:
+    if start_step <= 0:
+        return it
+    spe = loader.steps_per_epoch
+    loader.epoch = start_step // spe       # jump whole epochs for free
+    skip = start_step % spe
+    for _ in range(skip):                  # discard the epoch prefix
+        next(it)
+    return it

@@ -136,6 +136,7 @@ class Trainer:
             self.train_arrays, self.config.data.batch_size,
             prefetch=self.config.data.prefetch,
             native=self.config.data.native,
+            start_step=self.start_step,   # exact-resume: skip consumed batches
             process_index=self.process_index,
             num_processes=self.num_processes,
             shuffle=self.config.data.shuffle,
