@@ -222,14 +222,49 @@ def latest_checkpoint(directory: str) -> str | None:
     return mgr.checkpoint_path(step) if step is not None else None
 
 
+def _agreed_latest_step(manager: CheckpointManager) -> int | None:
+    """Latest step agreed across ALL processes.
+
+    The restore-or-init decision must be identical everywhere: if process 0
+    restores step N while another process inits fresh, the processes run
+    different loop lengths and deadlock at the first collective. Only the
+    chief's view is authoritative (it is the only writer), so its
+    latest_step is broadcast; every process then verifies it can actually
+    read that checkpoint — a mismatch means the checkpoint directory is not
+    a shared filesystem, which this manager requires for multi-host runs
+    (mirroring the reference, where workers restored through the chief's
+    session rather than their own disk — session_manager.py:320-335).
+    """
+    local = manager.latest_step()
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+    chief = int(multihost_utils.broadcast_one_to_all(
+        np.int64(-1 if local is None else local)))
+    chief_step = None if chief < 0 else chief
+    if chief_step is not None and not os.path.exists(
+            manager.checkpoint_path(chief_step)):
+        raise FileNotFoundError(
+            f"process {jax.process_index()} cannot read checkpoint step "
+            f"{chief_step} that process 0 will restore: the checkpoint "
+            f"directory {manager.directory!r} must be a filesystem shared "
+            "by all hosts")
+    return chief_step
+
+
 def restore_or_init(manager: CheckpointManager | None, init_fn,
                     *args, **kwargs):
     """The prepare_session decision (session_manager.py:320-335 parity):
     restore the latest checkpoint when one exists, else run ``init_fn``.
 
+    Multi-host: the decision (and the step restored) is broadcast from
+    process 0 so every process takes the same branch — see
+    :func:`_agreed_latest_step`.
+
     Returns ``(state, restored: bool)``.
     """
-    if manager is not None and manager.latest_step() is not None:
+    step = _agreed_latest_step(manager) if manager is not None else None
+    if step is not None:
         template = init_fn(*args, **kwargs)
-        return manager.restore(template), True
+        return manager.restore(template, step), True
     return init_fn(*args, **kwargs), False

@@ -63,7 +63,14 @@ def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                          mask: jax.Array | None = None,
                          causal: bool = False,
                          impl: str = "xla") -> jax.Array:
-    """[B,S,H,D] qkv -> [B,S,H,D] context. Softmax in f32."""
+    """[B,S,H,D] qkv -> [B,S,H,D] context. Softmax in f32.
+
+    Fully-masked query rows (no valid key) return ZEROS under every impl:
+    the flash/ring online-softmax recurrences produce 0 there naturally,
+    and the xla path zeroes them explicitly (plain softmax over an all-
+    NEG_INF row would return the uniform average of V instead). This keeps
+    impl= a drop-in swap at padded rows.
+    """
     if impl == "flash":
         from .pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, mask=mask, causal=causal)
@@ -72,6 +79,11 @@ def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     scores = attention_scores(q, k)
     scores = apply_mask(scores, mask, causal=causal)
     probs = jax.nn.softmax(scores, axis=-1)
+    if mask is not None or causal:
+        # zero fully-masked rows (same semantics as the flash/ring
+        # recurrence); unmasked non-causal calls can't have any
+        any_valid = jnp.any(scores > NEG_INF / 2, axis=-1, keepdims=True)
+        probs = jnp.where(any_valid, probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(v.dtype)

@@ -158,7 +158,11 @@ def batchnorm(params: Params, extras: Params, x: jax.Array, *,
               ) -> tuple[jax.Array, Params]:
     """BatchNorm over N,H,W (all but last). In the auto sync mode the batch
     dim is globally sharded, so these are global-batch statistics (sync-BN).
-    Returns (y, new_extras)."""
+    Under ``sync.mode='shard_map'`` the mean/var here are taken over the
+    *local* per-replica batch instead (running stats are pmean'd after the
+    step, but the forward normalization differs from auto mode) — BN models
+    are excluded from the auto==shard_map equivalence claim; see
+    ``parallel.sync_replicas``. Returns (y, new_extras)."""
     if train:
         axes = tuple(range(x.ndim - 1))
         mean = jnp.mean(x, axis=axes)

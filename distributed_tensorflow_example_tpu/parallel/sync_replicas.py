@@ -29,6 +29,13 @@ Two implementations are provided:
 - ``mode="shard_map"``: explicit per-replica SPMD with a hand-written
   ``pmean`` — the literal accumulate/average/apply dataflow, useful for
   pedagogy and for asserting the auto path's semantics in tests.
+  CAVEAT: batch statistics computed inside the loss (BatchNorm) are
+  per-replica here (local-batch mean/var in the forward pass; running
+  stats pmean'd afterwards), while ``mode="auto"`` yields global-batch
+  sync-BN statistics. The auto==shard_map equivalence therefore holds for
+  models without cross-batch statistics (MLP/transformers); BN models are
+  excluded from the claim (matches the reference, whose per-worker
+  towers also normalized with local-batch statistics).
 
 ``accum_steps > 1`` adds microbatch gradient accumulation via ``lax.scan``
 (accumulate-N-then-apply *within* a replica — the TPU-meaningful residue of

@@ -156,34 +156,53 @@ class Trainer:
         device_metrics: dict | None = None
         t_start = time.perf_counter()
 
-        while not stop:
-            batch = self.sync.shard_batch(next(loader))
-            state, device_metrics = self.sync.step(state, batch)
-            self.state = state
-            step += 1
+        try:
+            while not stop:
+                batch = self.sync.shard_batch(next(loader))
+                state, device_metrics = self.sync.step(state, batch)
+                self.state = state
+                step += 1
 
-            wants = any(h.wants_metrics(step) for h in self.hooks)
-            host_metrics = None
-            if wants:
-                host_metrics = {k: float(v) for k, v in
-                                jax.device_get(device_metrics).items()}
+                wants = any(h.wants_metrics(step) for h in self.hooks)
+                host_metrics = None
+                if wants:
+                    host_metrics = {k: float(v) for k, v in
+                                    jax.device_get(device_metrics).items()}
+                for h in self.hooks:
+                    if h.after_step(self, step, host_metrics):
+                        stop = True
+
+                if (self.config.eval_every_steps
+                        and step % self.config.eval_every_steps == 0
+                        and self.eval_arrays is not None):
+                    ev = self.evaluate(state)
+                    log.info("eval @ step %d: %s", step,
+                             {k: round(v, 4) for k, v in ev.items()})
+                    self.metrics_logger.log({"step": step, "eval": ev})
+
+            # block on the final step so hook teardown sees settled state
+            jax.block_until_ready(state.params)
+            wall = time.perf_counter() - t_start
+        finally:
+            # teardown must run even when a hook raises mid-loop (NanHook's
+            # FloatingPointError is its *default* behavior) — the reference's
+            # Supervisor shutdown still saved and closed services. A hook
+            # end() error must not mask an in-flight loop exception.
+            import sys as _sys
+            in_flight = _sys.exc_info()[0] is not None
+            end_error: Exception | None = None
             for h in self.hooks:
-                if h.after_step(self, step, host_metrics):
-                    stop = True
-
-            if (self.config.eval_every_steps
-                    and step % self.config.eval_every_steps == 0
-                    and self.eval_arrays is not None):
-                ev = self.evaluate(state)
-                log.info("eval @ step %d: %s", step,
-                         {k: round(v, 4) for k, v in ev.items()})
-                self.metrics_logger.log({"step": step, "eval": ev})
-
-        # block on the final step so hook teardown sees settled state
-        jax.block_until_ready(state.params)
-        wall = time.perf_counter() - t_start
-        for h in self.hooks:
-            h.end(self)
+                try:
+                    h.end(self)
+                except Exception as e:
+                    # every hook still gets its end(); first error re-raised
+                    # after — unless a loop exception is already in flight,
+                    # which must not be masked
+                    log.exception("hook %s end() failed", type(h).__name__)
+                    if end_error is None:
+                        end_error = e
+            if end_error is not None and not in_flight:
+                raise end_error
 
         summary: dict[str, Any] = {
             "final_step": step,

@@ -43,3 +43,19 @@ def test_graft_entry_contract():
     assert compiled is not None
     g.dryrun_multichip(8)
     g.dryrun_multichip(4)
+
+
+def test_dryrun_multichip_hermetic():
+    """The driver calls dryrun_multichip in an env we don't control — no
+    XLA_FLAGS, no JAX_PLATFORMS, possibly a broken default accelerator
+    backend (MULTICHIP_r01.json). The entry point must force the CPU
+    platform itself before any JAX op."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, f"stderr:\n{out.stderr[-3000:]}"
+    assert "dryrun_multichip(8): ok" in out.stdout
