@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Sync-replica scaling curve: step time vs N chips (BASELINE.json:2).
+
+Runs the sync data-parallel step at data-axis sizes 1/2/4/8 (and every
+power of two up to the available device count) with a FIXED per-replica
+batch (weak scaling — the reference's N-worker regime), and emits one JSON
+line per N::
+
+    {"n": 4, "model": "mlp", "step_ms": 1.2, "examples_per_sec": ...,
+     "examples_per_sec_per_chip": ..., "platform": "tpu"}
+
+On real multi-chip hardware this IS the scaling-curve row; on a single
+chip or the virtual CPU mesh it validates shape/sharding correctness and
+the harness itself, so the row can be filled the day a pod exists (the
+numbers are only meaningful on real chips — CPU step times are not TPU
+step times and are labeled as such by "platform").
+
+Usage: python bench_scaling.py [--model mlp] [--per_replica_batch 1024]
+       [--cpu]  (force the virtual CPU mesh)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="mlp")
+    ap.add_argument("--per_replica_batch", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force an 8-device virtual CPU mesh")
+    args = ap.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                           MeshShape,
+                                                           OptimizerConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+        SyncReplicas)
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    sizes = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= len(devices)]
+
+    for n in sizes:
+        batch = args.per_replica_batch * n      # weak scaling
+        cfg = TrainConfig(model=args.model, dtype="bfloat16",
+                          data=DataConfig(batch_size=batch),
+                          optimizer=OptimizerConfig(name="sgd",
+                                                    learning_rate=0.1))
+        model = get_model(args.model, cfg)
+        mesh = build_mesh(MeshShape(data=n), devices=devices[:n])
+        sync = SyncReplicas(model.loss, make_optimizer(cfg.optimizer), mesh)
+        state = sync.init(model.init, seed=0)
+        placed = sync.shard_batch(model.dummy_batch(batch))
+
+        for _ in range(args.warmup):
+            state, m = sync.step(state, placed)
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, m = sync.step(state, placed)
+        jax.block_until_ready(state.params)
+        dt = (time.perf_counter() - t0) / args.steps
+
+        print(json.dumps({
+            "n": n,
+            "model": args.model,
+            "per_replica_batch": args.per_replica_batch,
+            "step_ms": round(dt * 1e3, 3),
+            "examples_per_sec": round(batch / dt, 1),
+            "examples_per_sec_per_chip": round(batch / dt / n, 1),
+            "platform": platform,
+        }))
+
+
+if __name__ == "__main__":
+    main()

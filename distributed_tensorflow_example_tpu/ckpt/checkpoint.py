@@ -99,11 +99,20 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 5,
-                 keep_every_n_hours: float = 0.0):
+                 keep_every_n_hours: float = 0.0, async_save: bool = False):
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.keep_every_n_hours = keep_every_n_hours
+        self.async_save = async_save
         self._lock = threading.Lock()
+        self._pending: "Future | None" = None
+        self._executor = None
+        if async_save:
+            from concurrent.futures import ThreadPoolExecutor
+            # one writer thread, depth-1 queue: the reference's
+            # SVTimerCheckpointThread wrote one checkpoint at a time too
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer")
         # start the keep-forever clock now (TF Saver semantics): the first
         # interval must actually elapse before a checkpoint is pinned
         self._last_kept_forever = time.time()
@@ -134,6 +143,7 @@ class CheckpointManager:
         return os.path.join(self.directory, f"{PREFIX}-{step}.npz")
 
     def all_steps(self) -> list[int]:
+        self.wait()                # async write may not have landed yet
         st = self._state()
         steps = []
         for p in st["all_model_checkpoint_paths"] + st.get("kept_forever", []):
@@ -147,15 +157,41 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     # -- save / restore ---------------------------------------------------
+    def wait(self) -> None:
+        """Block until an in-flight async write has landed (no-op when
+        nothing is pending). Raises the writer thread's exception, if any."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def close(self) -> None:
+        self.wait()
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+
     def save(self, state: PyTree, step: int | None = None) -> str | None:
         """Gather to host and write ``ckpt-<step>.npz``; rotate the ring.
         Non-writer processes only participate in the device_get (so all
-        hosts stay in lockstep) and return None."""
+        hosts stay in lockstep) and return None.
+
+        With ``async_save``, the host gather is still synchronous (it is a
+        cross-process collective for non-addressable arrays) but the disk
+        write happens on a background thread — the analogue of the
+        reference's checkpoint thread running off the training loop
+        (supervisor.py:1098). A new save waits for the previous write.
+        """
         if step is None:
             step = int(jax.device_get(state.step))
         arrays = _flatten(state)
         if not self.is_writer:
             return None
+        if self._executor is not None:
+            self.wait()   # depth-1 queue; surfaces previous write errors
+            self._pending = self._executor.submit(self._write, arrays, step)
+            return self.checkpoint_path(step)
+        return self._write(arrays, step)
+
+    def _write(self, arrays: dict[str, np.ndarray], step: int) -> str:
         with self._lock:
             path = self.checkpoint_path(step)
             fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
@@ -202,6 +238,7 @@ class CheckpointManager:
     def restore(self, template: PyTree, step: int | None = None) -> PyTree:
         """Load ``step`` (default: latest) into the template's structure &
         shardings. Raises FileNotFoundError when nothing exists."""
+        self.wait()                # an in-flight async write may be `step`
         if step is None:
             step = self.latest_step()
             if step is None:

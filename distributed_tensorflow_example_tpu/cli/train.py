@@ -48,8 +48,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=128,
                    help="GLOBAL batch size")
     p.add_argument("--train_steps", type=int, default=1000)
+    p.add_argument("--steps_per_loop", type=int, default=1,
+                   help="training steps per device dispatch (lax.scan "
+                        "inner loop; hook cadences must be multiples)")
     p.add_argument("--learning_rate", type=float, default=0.5)
     p.add_argument("--optimizer", default="sgd")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--warmup_steps", type=int, default=0,
+                   help="linear LR warmup steps")
+    p.add_argument("--decay_schedule", default="constant",
+                   choices=["constant", "cosine", "linear"])
+    p.add_argument("--grad_clip_norm", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 disables)")
     p.add_argument("--accum_steps", type=int, default=1)
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
@@ -65,11 +76,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_steps", type=int, default=0)
     p.add_argument("--save_secs", type=float, default=0.0)
     p.add_argument("--max_to_keep", type=int, default=5)
+    p.add_argument("--keep_checkpoint_every_n_hours", type=float, default=0.0,
+                   help="pin one checkpoint outside the max_to_keep ring "
+                        "every N hours (TF Saver semantics; 0 disables)")
+    p.add_argument("--async_save", action="store_true",
+                   help="write checkpoints on a background thread (the "
+                        "reference's checkpoint-thread behavior)")
     p.add_argument("--log_every_steps", type=int, default=100)
+    p.add_argument("--summary_every_steps", type=int, default=0,
+                   help="scalar-summary cadence to the metrics JSONL "
+                        "(SummarySaverHook parity; 0 disables)")
     p.add_argument("--metrics_path", default=None)
     p.add_argument("--eval_every_steps", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--check_nans", action="store_true")
+    p.add_argument("--check_nans", action="store_true",
+                   help="stop on non-finite loss (NanTensorHook parity; "
+                        "per-step host sync)")
+    p.add_argument("--debug_checks", action="store_true",
+                   help="checkify float_checks around the compiled step: "
+                        "any NaN/Inf produced inside the program raises at "
+                        "the step where it occurs (debug-only cost)")
+    p.add_argument("--debug_nans", action="store_true",
+                   help="enable jax_debug_nans (eager NaN tracebacks)")
     p.add_argument("--profile_dir", default=None)
     p.add_argument("--profile_steps", default=None,
                    help="start,stop step range for the profiler hook")
@@ -95,6 +123,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         model=args.model,
         train_steps=args.train_steps,
         eval_every_steps=args.eval_every_steps,
+        steps_per_loop=args.steps_per_loop,
         seed=args.seed,
         dtype=args.dtype,
         attention_impl=args.attention,
@@ -106,16 +135,27 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                         max_per_class=args.max_per_class),
         optimizer=OptimizerConfig(name=args.optimizer,
                                   learning_rate=args.learning_rate,
+                                  momentum=args.momentum,
+                                  weight_decay=args.weight_decay,
+                                  warmup_steps=args.warmup_steps,
+                                  decay_schedule=args.decay_schedule,
+                                  grad_clip_norm=args.grad_clip_norm,
                                   total_steps=args.train_steps),
         sync=SyncConfig(accum_steps=args.accum_steps, mode=args.sync_mode),
-        checkpoint=CheckpointConfig(directory=args.ckpt_dir,
-                                    max_to_keep=args.max_to_keep,
-                                    save_steps=args.save_steps,
-                                    save_secs=args.save_secs),
+        checkpoint=CheckpointConfig(
+            directory=args.ckpt_dir,
+            max_to_keep=args.max_to_keep,
+            save_steps=args.save_steps,
+            save_secs=args.save_secs,
+            keep_checkpoint_every_n_hours=args.keep_checkpoint_every_n_hours,
+            async_save=args.async_save),
         obs=ObservabilityConfig(
             log_every_steps=args.log_every_steps,
+            summary_every_steps=args.summary_every_steps,
             metrics_path=args.metrics_path,
             check_nans=args.check_nans,
+            debug_checks=args.debug_checks,
+            debug_nans=args.debug_nans,
             profile_dir=args.profile_dir,
             profile_steps=profile_steps),
     )
@@ -186,6 +226,9 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     cfg = config_from_args(args)
+    if cfg.obs.debug_nans:
+        import jax
+        jax.config.update("jax_debug_nans", True)
     from ..models import get_model
     from ..train.trainer import Trainer
 

@@ -115,6 +115,9 @@ class ObservabilityConfig:
     profile_dir: str | None = None
     check_nans: bool = False          # NanTensorHook analogue
     summary_every_steps: int = 0      # scalar summary cadence (0 disables)
+    debug_checks: bool = False        # checkify float_checks around the step
+                                      # (SURVEY.md §5.2); debug-only cost
+    debug_nans: bool = False          # jax.config jax_debug_nans flag
 
 
 @dataclasses.dataclass
@@ -130,6 +133,9 @@ class TrainConfig:
     obs: ObservabilityConfig = dataclasses.field(default_factory=ObservabilityConfig)
     train_steps: int = 1000
     eval_every_steps: int = 0        # 0 => eval only at the end
+    steps_per_loop: int = 1          # steps per device dispatch (lax.scan
+                                     # inner loop — TPU-era iterations_per_loop
+                                     # semantics; hook cadences must divide)
     seed: int = 0
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     param_dtype: str = "float32"

@@ -139,15 +139,16 @@ int dl_cifar_read(const char* path, float* out_x, int32_t* out_y,
 // ---------------------------------------------------------------------------
 
 struct Slot {
-  std::vector<unsigned char> x, y;
+  std::vector<std::vector<unsigned char>> bufs;  // one buffer per array
   int64_t seq = -1;               // batch sequence number held in this slot
   std::atomic<bool> ready{false};
 };
 
 struct DLoader {
-  const unsigned char* x_data;    // borrowed from Python (numpy-owned)
-  const unsigned char* y_data;
-  int64_t row_x, row_y;           // bytes per example row
+  std::vector<const unsigned char*> datas;  // borrowed (numpy-owned); the
+                                            // batch layout is N parallel
+                                            // arrays (BERT batches carry 6)
+  std::vector<int64_t> rows;                // bytes per example row, per array
   int64_t n_rows;
   int64_t batch;                  // examples per (local) batch
   int depth;                      // ring depth
@@ -170,8 +171,9 @@ struct DLoader {
     const int64_t base = (seq % n_batches) * batch;
     for (int64_t i = 0; i < batch; ++i) {
       int64_t src = perm[base + i];
-      memcpy(s.x.data() + i * row_x, x_data + src * row_x, (size_t)row_x);
-      memcpy(s.y.data() + i * row_y, y_data + src * row_y, (size_t)row_y);
+      for (size_t a = 0; a < datas.size(); ++a)
+        memcpy(s.bufs[a].data() + i * rows[a], datas[a] + src * rows[a],
+               (size_t)rows[a]);
     }
     {
       // publish under the lock so a waiter between predicate-check and
@@ -212,21 +214,28 @@ struct DLoader {
   }
 };
 
-// Create a loader over borrowed row-major arrays. local batch only — the
-// process's shard of the global batch; sharding policy stays in Python.
-DLoader* dl_create(const unsigned char* x, int64_t row_x,
-                   const unsigned char* y, int64_t row_y,
-                   int64_t n_rows, int64_t batch, int depth, int workers) {
-  if (!x || !y || batch <= 0 || depth <= 0 || n_rows < batch) return nullptr;
+// Create a loader over N borrowed row-major arrays (the batch dict's
+// arrays in a fixed key order — any layout, e.g. BERT's 6-array batches).
+// local batch only — the process's shard of the global batch; sharding
+// policy stays in Python.
+DLoader* dl_create(const unsigned char* const* arrays, const int64_t* row_bytes,
+                   int n_arrays, int64_t n_rows, int64_t batch, int depth,
+                   int workers) {
+  if (!arrays || !row_bytes || n_arrays <= 0 || batch <= 0 || depth <= 0 ||
+      n_rows < batch)
+    return nullptr;
+  for (int a = 0; a < n_arrays; ++a)
+    if (!arrays[a] || row_bytes[a] <= 0) return nullptr;
   auto* L = new DLoader();
-  L->x_data = x; L->y_data = y;
-  L->row_x = row_x; L->row_y = row_y;
+  L->datas.assign(arrays, arrays + n_arrays);
+  L->rows.assign(row_bytes, row_bytes + n_arrays);
   L->n_rows = n_rows; L->batch = batch;
   L->depth = depth; L->workers = workers > 0 ? workers : 2;
   L->slots = std::vector<Slot>(depth);
   for (auto& s : L->slots) {
-    s.x.resize((size_t)(batch * row_x));
-    s.y.resize((size_t)(batch * row_y));
+    s.bufs.resize(n_arrays);
+    for (int a = 0; a < n_arrays; ++a)
+      s.bufs[a].resize((size_t)(batch * row_bytes[a]));
   }
   for (int i = 0; i < L->workers; ++i)
     L->threads.emplace_back([L] { L->worker(); });
@@ -253,10 +262,11 @@ int dl_set_epoch(DLoader* L, const int64_t* perm, int64_t perm_len) {
   return 0;
 }
 
-// Blocking: acquire pointers to the next assembled batch. Caller must call
-// dl_release before the slot can be refilled. Returns 0, or -1 on shutdown,
-// -2 when no epoch is installed.
-int dl_acquire(DLoader* L, unsigned char** out_x, unsigned char** out_y) {
+// Blocking: acquire pointers to the next assembled batch — out_ptrs must
+// have room for n_arrays pointers. Caller must call dl_release before the
+// slot can be refilled. Returns 0, or -1 on shutdown, -2 when no epoch is
+// installed.
+int dl_acquire(DLoader* L, unsigned char** out_ptrs) {
   if (!L) return -1;
   if (L->epoch_end.load() == 0) return -2;
   Slot& s = L->slots[L->next_to_serve % L->depth];
@@ -267,8 +277,7 @@ int dl_acquire(DLoader* L, unsigned char** out_x, unsigned char** out_y) {
             s.seq == L->next_to_serve);
   });
   if (L->stop.load()) return -1;
-  *out_x = s.x.data();
-  *out_y = s.y.data();
+  for (size_t a = 0; a < s.bufs.size(); ++a) out_ptrs[a] = s.bufs[a].data();
   return 0;
 }
 
@@ -294,7 +303,8 @@ void dl_destroy(DLoader* L) {
   delete L;
 }
 
-// Version tag for Python-side compatibility checks.
-int dl_abi_version() { return 1; }
+// Version tag for Python-side compatibility checks. v2: N-array batches
+// (dl_create takes array/row-byte vectors, dl_acquire fills N pointers).
+int dl_abi_version() { return 2; }
 
 }  // extern "C"

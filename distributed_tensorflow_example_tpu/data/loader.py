@@ -125,9 +125,10 @@ def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
                 native: bool = False, start_step: int = 0,
                 **kw) -> Iterator[Batch]:
     """Build a batch iterator. ``native=True`` uses the C++ loader
-    (data/native.py) when the library is available and the batch layout is
-    the two-array (x, y) kind; otherwise silently falls back to the Python
-    path — both yield bit-identical batch sequences.
+    (data/native.py) when the library is available — any N-array batch
+    layout (ABI v2; BERT's 6-array batches included); otherwise silently
+    falls back to the Python path — both yield bit-identical batch
+    sequences.
 
     ``start_step`` fast-forwards the deterministic batch sequence so a
     restored run consumes exactly the batches an uninterrupted run would
@@ -136,7 +137,7 @@ def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
     only the current epoch's prefix is discarded.
     """
     loader: ShardedLoader | None = None
-    if native and len(arrays) == 2:
+    if native and arrays:
         from . import native as native_mod
         if native_mod.available():
             kw.pop("drop_remainder", None)   # native is always drop_remainder

@@ -24,11 +24,35 @@ import numpy as np
 
 _DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
 _SO = os.path.join(_DIR, "libdtxdata.so")
-_ABI = 1
+_ABI = 2
 
 _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
+
+
+def _make() -> bool:
+    """Rebuild the .so, serialized across processes: concurrent `make`s
+    would rewrite the library non-atomically under a sibling's dlopen."""
+    try:
+        import fcntl
+        with open(os.path.join(_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _needs_build() -> bool:
+    """Same staleness rule as the Makefile — decided WITHOUT dlopen, since
+    dlopen caches by path and a stale library once loaded cannot be
+    reliably replaced in-process."""
+    if not os.path.exists(_SO):
+        return True
+    src = os.path.join(_DIR, "dataloader.cpp")
+    return os.path.getmtime(_SO) < os.path.getmtime(src)
 
 
 def _load() -> ctypes.CDLL | None:
@@ -38,13 +62,9 @@ def _load() -> ctypes.CDLL | None:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_SO):
-            try:
-                subprocess.run(["make", "-C", _DIR, "-s"], check=True,
-                               capture_output=True, timeout=120)
-            except (OSError, subprocess.SubprocessError):
-                _build_failed = True
-                return None
+        if _needs_build() and not _make():
+            _build_failed = True
+            return None
         try:
             lib = ctypes.CDLL(_SO)
         except OSError:
@@ -55,14 +75,13 @@ def _load() -> ctypes.CDLL | None:
             return None
         # signatures
         lib.dl_create.restype = ctypes.c_void_p
-        lib.dl_create.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                  ctypes.c_void_p, ctypes.c_int64,
-                                  ctypes.c_int64, ctypes.c_int64,
-                                  ctypes.c_int, ctypes.c_int]
+        lib.dl_create.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_int, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_int, ctypes.c_int]
         lib.dl_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                      ctypes.c_int64]
         lib.dl_acquire.argtypes = [ctypes.c_void_p,
-                                   ctypes.POINTER(ctypes.c_void_p),
                                    ctypes.POINTER(ctypes.c_void_p)]
         lib.dl_release.argtypes = [ctypes.c_void_p]
         lib.dl_destroy.argtypes = [ctypes.c_void_p]
@@ -169,17 +188,13 @@ class NativeLoader:
         if global_batch % num_processes:
             raise ValueError("global_batch not divisible by num_processes")
         self._lib = lib
-        self.keys = sorted(arrays)
-        if len(self.keys) != 2:
-            raise ValueError(
-                "NativeLoader handles exactly two arrays (x-like, y-like); "
-                f"got {self.keys} — use the Python loader for other layouts")
-        kx, ky = self.keys
+        self.keys = sorted(arrays)   # fixed key order = array order in C++
+        if not self.keys:
+            raise ValueError("empty batch layout")
         # keep references: the C++ side borrows these buffers
-        self._x = np.ascontiguousarray(arrays[kx])
-        self._y = np.ascontiguousarray(arrays[ky])
-        self.n = len(self._x)
-        if len(self._y) != self.n:
+        self._arrays = [np.ascontiguousarray(arrays[k]) for k in self.keys]
+        self.n = len(self._arrays[0])
+        if any(len(a) != self.n for a in self._arrays):
             raise ValueError("array length mismatch")
         self.global_batch = global_batch
         self.local_batch = global_batch // num_processes
@@ -188,14 +203,16 @@ class NativeLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.epoch = 0
-        self._row_x = self._x.dtype.itemsize * int(
-            np.prod(self._x.shape[1:], dtype=np.int64))
-        self._row_y = self._y.dtype.itemsize * int(
-            np.prod(self._y.shape[1:], dtype=np.int64)) or self._y.dtype.itemsize
-        self._handle = lib.dl_create(
-            self._x.ctypes.data_as(ctypes.c_void_p), self._row_x,
-            self._y.ctypes.data_as(ctypes.c_void_p), self._row_y,
-            self.n, self.local_batch, depth, workers)
+        self._rows = [
+            max(1, a.dtype.itemsize
+                * int(np.prod(a.shape[1:], dtype=np.int64)))
+            for a in self._arrays]
+        na = len(self._arrays)
+        ptrs = (ctypes.c_void_p * na)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays])
+        rows = (ctypes.c_int64 * na)(*self._rows)
+        self._handle = lib.dl_create(ptrs, rows, na, self.n,
+                                     self.local_batch, depth, workers)
         if not self._handle:
             raise RuntimeError("dl_create failed")
         self._batches_left = 0
@@ -224,31 +241,26 @@ class NativeLoader:
         self.epoch += 1
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        kx, ky = self.keys
-        x_shape = (self.local_batch,) + self._x.shape[1:]
-        y_shape = (self.local_batch,) + self._y.shape[1:]
-        px = ctypes.c_void_p()
-        py = ctypes.c_void_p()
+        na = len(self._arrays)
+        shapes = [(self.local_batch,) + a.shape[1:] for a in self._arrays]
+        ptrs = (ctypes.c_void_p * na)()
         while True:
             if self._batches_left == 0:
                 self._install_epoch()
-            rc = self._lib.dl_acquire(self._handle, ctypes.byref(px),
-                                      ctypes.byref(py))
+            rc = self._lib.dl_acquire(self._handle, ptrs)
             if rc:
                 raise RuntimeError(f"dl_acquire -> {rc}")
             # copy out before release (device_put would copy anyway; this
             # keeps the ring slot turnover independent of consumer pace)
-            x = np.frombuffer(
-                (ctypes.c_char * (self.local_batch * self._row_x)
-                 ).from_address(px.value), dtype=self._x.dtype
-            ).reshape(x_shape).copy()
-            y = np.frombuffer(
-                (ctypes.c_char * (self.local_batch * self._row_y)
-                 ).from_address(py.value), dtype=self._y.dtype
-            ).reshape(y_shape).copy()
+            batch = {}
+            for i, key in enumerate(self.keys):
+                nbytes = self.local_batch * self._rows[i]
+                batch[key] = np.frombuffer(
+                    (ctypes.c_char * nbytes).from_address(ptrs[i]),
+                    dtype=self._arrays[i].dtype).reshape(shapes[i]).copy()
             self._lib.dl_release(self._handle)
             self._batches_left -= 1
-            yield {kx: x, ky: y}
+            yield batch
 
     def close(self) -> None:
         if getattr(self, "_handle", None):

@@ -1,22 +1,28 @@
 """Blocked flash attention (forward + backward) as Pallas TPU kernels.
 
 Memory-efficient attention: never materializes the [S, S] score matrix.
-The forward kernel streams K/V blocks through VMEM with the online-softmax
-recurrence (running max ``m`` / normalizer ``l``) and saves only the
-per-row logsumexp ``L`` for the backward; the backward recomputes
-probabilities blockwise (dq kernel loops K-blocks, dk/dv kernel loops
-Q-blocks) — the standard flash-attention-2 decomposition.
+VMEM use is O(block), independent of S: K/V blocks are *streamed through
+the grid* (the innermost, sequential grid dimension walks K blocks while
+the online-softmax state — running max ``m``, normalizer ``l``, output
+accumulator — lives in VMEM scratch that persists across grid steps). The
+backward recomputes probabilities blockwise from the saved per-row
+logsumexp ``L``: the dq kernel streams K blocks, the dk/dv kernel streams
+Q/dO blocks — the standard flash-attention-2 decomposition, with both
+operand streams O(block) as well.
 
 Layout: inputs [B, S, H, D] (the framework's BSHD convention) are folded to
-[B*H, S, D] so the grid is (batch·head, block index) and every program's
-matmuls are [block, D] x [D, block] MXU tiles.
+[B*H, S, D] so the grid is (batch·head, q/k block, k/q block) and every
+program's matmuls are [block, D] x [D, block] MXU tiles.
 
-Scope/fallbacks: S must divide by the block size and D should be MXU-lane
-friendly (64/128); `flash_attention` falls back to the XLA path otherwise.
-On non-TPU backends kernels run in Pallas interpret mode (tests on the
-virtual CPU mesh exercise the same code path).
+Scope/fallbacks: the kernel path requires MXU/Mosaic-friendly tiles —
+S divisible by both block sizes, a lane-aligned K block (multiple of 128),
+sublane-aligned Q block (multiple of 8) and D in {64, 128·k}. Anything else
+(short sequences, odd head dims) falls back to the XLA path, which is the
+right tool there anyway. On non-TPU backends kernels run in Pallas
+interpret mode (tests on the virtual CPU mesh exercise the same code path).
 
-Shares mask semantics with ops/attention.py (NEG_INF, 1 = attend).
+Shares mask semantics with ops/attention.py (NEG_INF, 1 = attend); fully
+masked query rows yield zeros (matching ``multi_head_attention``).
 """
 
 from __future__ import annotations
@@ -28,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..attention import NEG_INF
 
@@ -38,56 +45,66 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _block_mask(s, mask_row, causal: bool, q_start, k_start,
+                blk_q: int, blk_k: int):
+    """Apply key-validity row mask and/or causal mask to a score block."""
+    if mask_row is not None:
+        s = jnp.where(mask_row != 0, s, NEG_INF)
+    if causal:
+        qpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0) + q_start
+        kpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1) + k_start
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------------------
-# forward kernel
+# forward kernel: grid (BH, nq, nk) — nk innermost, sequential, carries the
+# online-softmax state in scratch
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, l_ref, *,
-                blk_q: int, blk_k: int, seq_len: int, causal: bool,
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *,
+                blk_q: int, blk_k: int, nk: int, causal: bool,
                 sm_scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale          # [blk_q, D]
-    d = q.shape[-1]
+    ki = pl.program_id(2)
 
-    m0 = jnp.full((blk_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((blk_q, 1), jnp.float32)
-    acc0 = jnp.zeros((blk_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    nk = seq_len // blk_k
-    if causal:
-        # blocks strictly above the diagonal contribute nothing
-        nk = jnp.minimum(nk, (qi + 1) * blk_q // blk_k
-                         + (1 if blk_q % blk_k else 0))
+    # causal: blocks strictly above the diagonal contribute nothing
+    live = ((qi + 1) * blk_q - 1 >= ki * blk_k) if causal else True
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(i * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * blk_k, blk_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if mask_ref is not None:
-            mrow = mask_ref[0, 0, pl.ds(i * blk_k, blk_k)]
-            s = jnp.where(mrow[None, :] != 0, s, NEG_INF)
-        if causal:
-            qpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0) \
-                + qi * blk_q
-            kpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1) \
-                + i * blk_k
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        mrow = mask_ref[0] if mask_ref is not None else None  # [1, blk_k]
+        s = _block_mask(s, mrow, causal, qi * blk_q, ki * blk_k,
+                        blk_q, blk_k)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.dot(p, v,
-                                       preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
 
-    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
-    # logsumexp per row, saved for the backward recompute; kept [blk_q, 1]
-    # (Mosaic tiling: 2D blocks need sublane%8, a trailing singleton dim
-    # sidesteps it by matching the array dim)
-    l_ref[0] = m + jnp.log(jnp.maximum(l, 1e-20))
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+        o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+        # logsumexp per row, saved for the backward recompute; kept
+        # [blk_q, 1] (a trailing singleton dim matches the array dim, which
+        # Mosaic tiles without sublane constraints)
+        lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-20))
 
 
 def _fwd(q3, k3, v3, mask2, *, heads: int, blk_q: int, blk_k: int,
@@ -95,34 +112,38 @@ def _fwd(q3, k3, v3, mask2, *, heads: int, blk_q: int, blk_k: int,
     """q3,k3,v3: [BH, S, D]; mask2: [B, S] or None. Returns (o, L)."""
     bh, s, d = q3.shape
     sm_scale = 1.0 / math.sqrt(d)
-    grid = (bh, s // blk_q)
+    nq, nk = s // blk_q, s // blk_k
+    grid = (bh, nq, nk)
 
-    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i: (b, i, 0))
-    kvspec = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
-    in_specs = [qspec, kvspec, kvspec]
+    in_specs = [pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0))]
     args = [q3, k3, v3]
+    kw = dict(blk_q=blk_q, blk_k=blk_k, nk=nk, causal=causal,
+              sm_scale=sm_scale)
     if mask2 is not None:
         in_specs.append(
-            pl.BlockSpec((1, 1, s), lambda b, i: (b // heads, 0, 0)))
+            pl.BlockSpec((1, 1, blk_k), lambda b, i, j: (b // heads, 0, j)))
         args.append(mask2[:, None, :])
-        kernel = functools.partial(
-            _fwd_kernel, blk_q=blk_q, blk_k=blk_k, seq_len=s,
-            causal=causal, sm_scale=sm_scale)
+        kernel = functools.partial(_fwd_kernel, **kw)
     else:
         kernel = functools.partial(
-            lambda qr, kr, vr, o, lr, **kw: _fwd_kernel(
-                qr, kr, vr, None, o, lr, **kw),
-            blk_q=blk_q, blk_k=blk_k, seq_len=s, causal=causal,
-            sm_scale=sm_scale)
+            lambda qr, kr, vr, o, lr, m, l, a, **k: _fwd_kernel(
+                qr, kr, vr, None, o, lr, m, l, a, **k), **kw)
 
     o, L = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, blk_q, d), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, blk_q, 1), lambda b, i: (b, i, 0))],
+        out_specs=[pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
                    jax.ShapeDtypeStruct((bh, s, 1), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((blk_q, 1), jnp.float32),
+                        pltpu.VMEM((blk_q, 1), jnp.float32),
+                        pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
     return o, L
@@ -133,149 +154,145 @@ def _fwd(q3, k3, v3, mask2, *, heads: int, blk_q: int, blk_k: int,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, mask_ref,
-                   dq_ref, *, blk_q: int, blk_k: int, seq_len: int,
+                   dq_ref, dq_scr, *, blk_q: int, blk_k: int, nk: int,
                    causal: bool, sm_scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)                   # [blk_q, D]
-    Lrow = L_ref[0]                                      # [blk_q, 1]
-    Drow = D_ref[0]
-    d = q.shape[-1]
+    ki = pl.program_id(2)
 
-    nk = seq_len // blk_k
-    if causal:
-        nk = jnp.minimum(nk, (qi + 1) * blk_q // blk_k
-                         + (1 if blk_q % blk_k else 0))
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def body(i, dq):
-        k = k_ref[0, pl.ds(i * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(i * blk_k, blk_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if mask_ref is not None:
-            mrow = mask_ref[0, 0, pl.ds(i * blk_k, blk_k)]
-            s = jnp.where(mrow[None, :] != 0, s, NEG_INF)
-        if causal:
-            qpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0) \
-                + qi * blk_q
-            kpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1) \
-                + i * blk_k
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - Lrow) * (s > NEG_INF / 2)        # [blk_q, blk_k]
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    live = ((qi + 1) * blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        Lrow, Drow = L_ref[0], D_ref[0]                   # [blk_q, 1]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        mrow = mask_ref[0] if mask_ref is not None else None
+        s = _block_mask(s, mrow, causal, qi * blk_q, ki * blk_k,
+                        blk_q, blk_k)
+        p = jnp.exp(s - Lrow) * (s > NEG_INF / 2)         # [blk_q, blk_k]
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
         ds = p * (dp - Drow) * sm_scale
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, nk, body, jnp.zeros((blk_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, mask_ref,
-                    dk_ref, dv_ref, *, blk_q: int, blk_k: int, seq_len: int,
-                    causal: bool, sm_scale: float):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, blk_q: int,
+                    blk_k: int, nq: int, causal: bool, sm_scale: float):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                     # [blk_k, D]
-    v = v_ref[0].astype(jnp.float32)
-    d = k.shape[-1]
-    if mask_ref is not None:
-        mrow = mask_ref[0, 0][None, :]                   # [1, blk_k]
-    nq = seq_len // blk_q
-    start_q = 0
-    if causal:
-        start_q = ki * blk_k // blk_q                    # skip above-diagonal
+    qi = pl.program_id(2)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32) \
-            * sm_scale
-        do = do_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
-        Lrow = L_ref[0, pl.ds(i * blk_q, blk_q), :]
-        Drow = D_ref[0, pl.ds(i * blk_q, blk_q), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if mask_ref is not None:
-            s = jnp.where(mrow != 0, s, NEG_INF)
-        if causal:
-            qpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0) \
-                + i * blk_q
-            kpos = lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1) \
-                + ki * blk_k
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        p = jnp.exp(s - Lrow) * (s > NEG_INF / 2)
-        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = ((qi + 1) * blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        Lrow, Drow = L_ref[0], D_ref[0]                   # [blk_q, 1]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        mrow = mask_ref[0] if mask_ref is not None else None
+        s = _block_mask(s, mrow, causal, qi * blk_q, ki * blk_k,
+                        blk_q, blk_k)
+        p = jnp.exp(s - Lrow) * (s > NEG_INF / 2)         # [blk_q, blk_k]
+        dv_scr[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # p.T @ do
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
         ds = p * (dp - Drow) * sm_scale
-        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+        dk_scr[...] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # ds.T @ q
 
-    dk0 = jnp.zeros((blk_k, d), jnp.float32)
-    dv0 = jnp.zeros((blk_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(start_q, nq, body, (dk0, dv0))
-    # dk accumulated against q*sm_scale: one sm_scale already applied in ds;
-    # q here is pre-scaled, so divide the double-applied scale back out
-    dk_ref[0] = (dk / sm_scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd(q3, k3, v3, o3, do3, L, mask2, *, heads: int, blk_q: int,
          blk_k: int, causal: bool):
     bh, s, d = q3.shape
     sm_scale = 1.0 / math.sqrt(d)
+    nq, nk = s // blk_q, s // blk_k
     Dsum = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                    axis=-1, keepdims=True)                # [BH, S, 1]
 
-    common = dict(blk_k=blk_k, blk_q=blk_q, seq_len=s, causal=causal,
-                  sm_scale=sm_scale)
-
-    def specs(blocked_q: bool):
-        big = pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0))
-        row = pl.BlockSpec((1, s, 1), lambda b, i: (b, 0, 0))
-        if blocked_q:
-            qs = pl.BlockSpec((1, blk_q, d), lambda b, i: (b, i, 0))
-            ls = pl.BlockSpec((1, blk_q, 1), lambda b, i: (b, i, 0))
-            return [qs, big, big, qs, ls, ls]
-        ks = pl.BlockSpec((1, blk_k, d), lambda b, i: (b, i, 0))
-        return [big, ks, ks, big, row, row]
-
-    mask_spec = pl.BlockSpec((1, 1, s), lambda b, i: (b // heads, 0, 0))
-    kmask_spec = pl.BlockSpec((1, 1, blk_k),
-                              lambda b, i: (b // heads, 0, i))
-
-    # dq: grid over q blocks
-    in_specs = specs(blocked_q=True)
+    # dq: grid (BH, nq, nk) — K/V streamed innermost
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, i, 0))
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     args = [q3, k3, v3, do3, L, Dsum]
+    kw = dict(blk_q=blk_q, blk_k=blk_k, nk=nk, causal=causal,
+              sm_scale=sm_scale)
     if mask2 is not None:
-        in_specs.append(mask_spec)
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_k), lambda b, i, j: (b // heads, 0, j)))
         args.append(mask2[:, None, :])
-        dq_kernel = functools.partial(_bwd_dq_kernel, **common)
+        dq_kernel = functools.partial(_bwd_dq_kernel, **kw)
     else:
         dq_kernel = functools.partial(
-            lambda qr, kr, vr, dor, lr, dr, dq, **kw: _bwd_dq_kernel(
-                qr, kr, vr, dor, lr, dr, None, dq, **kw), **common)
+            lambda qr, kr, vr, dor, lr, dr, dq, scr, **k: _bwd_dq_kernel(
+                qr, kr, vr, dor, lr, dr, None, dq, scr, **k), **kw)
     dq = pl.pallas_call(
-        dq_kernel, grid=(bh, s // blk_q), in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i: (b, i, 0)),
+        dq_kernel, grid=(bh, nq, nk), in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
 
-    # dk/dv: grid over k blocks
-    in_specs = specs(blocked_q=False)
+    # dk/dv: grid (BH, nk, nq) — Q/dO/L/D streamed innermost
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, j, 0))
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, i, 0))
+    rowspec = pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, j, 0))
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     args = [q3, k3, v3, do3, L, Dsum]
+    kw = dict(blk_q=blk_q, blk_k=blk_k, nq=nq, causal=causal,
+              sm_scale=sm_scale)
     if mask2 is not None:
-        in_specs.append(kmask_spec)
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_k), lambda b, i, j: (b // heads, 0, i)))
         args.append(mask2[:, None, :])
-        dkv_kernel = functools.partial(_bwd_dkv_kernel, **common)
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, **kw)
     else:
         dkv_kernel = functools.partial(
-            lambda qr, kr, vr, dor, lr, dr, dk, dv, **kw: _bwd_dkv_kernel(
-                qr, kr, vr, dor, lr, dr, None, dk, dv, **kw), **common)
+            lambda qr, kr, vr, dor, lr, dr, dk, dv, s1, s2, **k:
+            _bwd_dkv_kernel(qr, kr, vr, dor, lr, dr, None, dk, dv, s1, s2,
+                            **k), **kw)
     dk, dv = pl.pallas_call(
-        dkv_kernel, grid=(bh, s // blk_k), in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, blk_k, d), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, blk_k, d), lambda b, i: (b, i, 0))],
+        dkv_kernel, grid=(bh, nk, nq), in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct(k3.shape, k3.dtype),
                    jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
     return dq, dk, dv
@@ -310,6 +327,16 @@ def _make_flash(heads: int, blk_q: int, blk_k: int, causal: bool,
     return fn
 
 
+def _tile_friendly(s: int, d: int, blk_q: int, blk_k: int) -> bool:
+    """Mosaic tiling constraints for the kernel path: lane-dim K blocks
+    must be 128-multiples, sublane-dim Q blocks 8-multiples, and the head
+    dim MXU-aligned. Short/odd shapes fall back to XLA (which also dodges
+    interpret-mode-passes-but-Mosaic-fails drift on real TPU)."""
+    return (s % blk_q == 0 and s % blk_k == 0
+            and blk_q % 8 == 0 and blk_k % 128 == 0
+            and (d == 64 or d % 128 == 0))
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     mask: jax.Array | None = None, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK,
@@ -317,12 +344,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Drop-in for ``multi_head_attention(impl="xla")``: [B,S,H,D] in/out.
 
     ``mask``: [B,S] key-validity (1 = attend) or broadcastable [B,1,1,S].
-    Falls back to the XLA path when S doesn't divide the block size.
+    Falls back to the XLA path for tile-unfriendly shapes (see
+    ``_tile_friendly``).
     """
     b, s, h, d = q.shape
     blk_q = min(block_q, s)
     blk_k = min(block_k, s)
-    if s % blk_q or s % blk_k:
+    if not _tile_friendly(s, d, blk_q, blk_k):
         from ..attention import multi_head_attention
         m4 = None
         if mask is not None:

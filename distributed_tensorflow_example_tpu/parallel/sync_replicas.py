@@ -127,7 +127,8 @@ class SyncReplicas:
                  *,
                  sync: SyncConfig | None = None,
                  rules: ShardingRules | None = None,
-                 donate: bool = True):
+                 donate: bool = True,
+                 debug_checks: bool = False):
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh
@@ -146,11 +147,41 @@ class SyncReplicas:
             raise ValueError(f"unknown sync mode {self.sync.mode!r}")
 
         donate_args = (0,) if donate else ()
+        step_fn = (self._auto_step if self.sync.mode == "auto"
+                   else self._shard_map_step)
+        if debug_checks:
+            # SURVEY.md §5.2: checkify-instrumented step — every NaN/Inf
+            # produced *inside* the compiled program (not just in the final
+            # loss, as NanHook sees) is caught at the step where it occurs,
+            # with the op's source location. Debug-only: adds a host sync
+            # and error plumbing per step; no donation (checkify rewrites
+            # the jaxpr and aliasing is not worth fighting here).
+            from jax.experimental import checkify
+            checked = jax.jit(checkify.checkify(
+                step_fn, errors=checkify.float_checks))
+            checked_multi = jax.jit(checkify.checkify(
+                self._multi_step, errors=checkify.float_checks))
+
+            def step_with_checks(state, batch):
+                err, out = checked(state, batch)
+                checkify.check_error(err)
+                return out
+
+            def multi_step_with_checks(state, stacked):
+                err, out = checked_multi(state, stacked)
+                checkify.check_error(err)
+                return out
+
+            self.step = step_with_checks
+            self.multi_step = multi_step_with_checks
+            return
         if self.sync.mode == "auto":
             self.step = jax.jit(self._auto_step, donate_argnums=donate_args)
         else:
             self.step = jax.jit(self._shard_map_step,
                                 donate_argnums=donate_args)
+        self.multi_step = jax.jit(self._multi_step,
+                                  donate_argnums=donate_args)
 
     # ---- state / batch placement ---------------------------------------
     def init(self,
@@ -181,6 +212,17 @@ class SyncReplicas:
     def shard_batch(self, batch: Any) -> Any:
         from .sharding import shard_batch
         return shard_batch(self.mesh, batch)
+
+    def shard_stacked_batch(self, stacked: Any) -> Any:
+        """Place a [K, B, ...] stack of K batches for :meth:`multi_step`:
+        dim 0 is the loop axis (unsharded), dim 1 the batch split."""
+        sh = NamedSharding(self.mesh, batch_pspec(leading_extra=1))
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(sh, x),
+                stacked)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), stacked)
 
     # ---- step implementations ------------------------------------------
     def _update(self, state: TrainState, grads, loss, aux, new_extras):
@@ -232,6 +274,17 @@ class SyncReplicas:
             return self._update(st, grads, loss, aux, new_extras)
 
         return run(state, batch)
+
+    def _multi_step(self, state: TrainState, stacked_batches):
+        """K training steps in ONE device dispatch (``lax.scan`` over a
+        [K, B, ...] batch stack) — the analogue of the TPU-era
+        ``iterations_per_loop`` host→device loop: per-step host dispatch
+        (a real cost on latency-y links) is paid once per K steps.
+        Returns the state after K steps and the LAST step's metrics."""
+        step_fn = (self._auto_step if self.sync.mode == "auto"
+                   else self._shard_map_step)
+        state, metrics = lax.scan(step_fn, state, stacked_batches)
+        return state, jax.tree_util.tree_map(lambda a: a[-1], metrics)
 
 
 def make_sync_train_step(loss_fn: LossFn,

@@ -154,6 +154,7 @@ class CheckpointSaverHook(Hook):
         if self._last_saved_step != step:
             self.manager.save(trainer.state, step)
             self._last_saved_step = step
+        self.manager.wait()        # async writes must land before exit
 
 
 class NanHook(Hook):

@@ -69,13 +69,15 @@ class Trainer:
         self.tx = make_optimizer(config.optimizer)
         rules = model.sharding_rules(config.mesh)
         self.sync = SyncReplicas(model.loss, self.tx, self.mesh,
-                                 sync=config.sync, rules=rules)
+                                 sync=config.sync, rules=rules,
+                                 debug_checks=config.obs.debug_checks)
 
         self.ckpt_manager = (
             CheckpointManager(config.checkpoint.directory,
                               max_to_keep=config.checkpoint.max_to_keep,
                               keep_every_n_hours=(
-                                  config.checkpoint.keep_checkpoint_every_n_hours))
+                                  config.checkpoint.keep_checkpoint_every_n_hours),
+                              async_save=config.checkpoint.async_save)
             if config.checkpoint.directory else None)
         self.metrics_logger = MetricsLogger(config.obs.metrics_path)
 
@@ -88,6 +90,22 @@ class Trainer:
         self.start_step = 0
         self.hooks = self._default_hooks() + list(hooks or [])
         self._eval_fn = None
+
+        k = config.steps_per_loop
+        if k > 1:
+            # hooks fire on step % cadence == 0; a K-step jump only lands on
+            # those boundaries when the cadence divides by K (the same
+            # discipline TPU-era iterations_per_loop imposed)
+            for name, every in (("log_every_steps", config.obs.log_every_steps),
+                                ("summary_every_steps",
+                                 config.obs.summary_every_steps),
+                                ("save_steps", config.checkpoint.save_steps),
+                                ("eval_every_steps", config.eval_every_steps)):
+                if every and every % k:
+                    raise ValueError(
+                        f"{name}={every} must be a multiple of "
+                        f"steps_per_loop={k} (hooks only observe loop "
+                        "boundaries)")
 
     # ------------------------------------------------------------------
     def _default_hooks(self) -> list[hooks_lib.Hook]:
@@ -156,12 +174,24 @@ class Trainer:
         device_metrics: dict | None = None
         t_start = time.perf_counter()
 
+        spl = max(1, self.config.steps_per_loop)
         try:
             while not stop:
-                batch = self.sync.shard_batch(next(loader))
-                state, device_metrics = self.sync.step(state, batch)
+                remaining = self.config.train_steps - step
+                if spl > 1 and remaining >= spl:
+                    # K steps per dispatch (iterations_per_loop analogue):
+                    # stack K host batches on a leading loop axis and scan
+                    stack = [next(loader) for _ in range(spl)]
+                    stacked = {k: np.stack([b[k] for b in stack])
+                               for k in stack[0]}
+                    batch = self.sync.shard_stacked_batch(stacked)
+                    state, device_metrics = self.sync.multi_step(state, batch)
+                    step += spl
+                else:
+                    batch = self.sync.shard_batch(next(loader))
+                    state, device_metrics = self.sync.step(state, batch)
+                    step += 1
                 self.state = state
-                step += 1
 
                 wants = any(h.wants_metrics(step) for h in self.hooks)
                 host_metrics = None
@@ -218,8 +248,11 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def close(self) -> None:
-        """Release owned resources (the metrics JSONL handle). The Trainer
-        owns the MetricsLogger — hooks must not close it."""
+        """Release owned resources (the metrics JSONL handle, the async
+        checkpoint writer). The Trainer owns these — hooks must not close
+        them."""
+        if self.ckpt_manager is not None:
+            self.ckpt_manager.close()
         self.metrics_logger.close()
 
     def __enter__(self) -> "Trainer":

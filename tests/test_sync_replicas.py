@@ -117,6 +117,29 @@ def test_replicas_to_aggregate_mismatch_rejected():
                      sync=SyncConfig(replicas_to_aggregate=4))
 
 
+@pytest.mark.parametrize("mode", ["auto", "shard_map"])
+def test_multi_step_scan_equals_sequential_steps(mode):
+    """multi_step (K steps, one dispatch via lax.scan) == K sequential
+    step() calls — the iterations_per_loop correctness contract."""
+    K = 4
+    _, sync_seq, state_seq = _setup(8, mode=mode)
+    _, sync_k, state_k = _setup(8, mode=mode)
+    host = [_batch(i) for i in range(K)]
+
+    for b in host:
+        state_seq, m_seq = sync_seq.step(state_seq, sync_seq.shard_batch(b))
+
+    stacked = {k: np.stack([b[k] for b in host]) for k in host[0]}
+    state_k, m_k = sync_k.multi_step(state_k,
+                                     sync_k.shard_stacked_batch(stacked))
+
+    assert int(state_k.step) == K
+    np.testing.assert_allclose(float(m_seq["loss"]), float(m_k["loss"]),
+                               rtol=1e-5)
+    assert_trees_close(_params_flat(state_seq), _params_flat(state_k),
+                       rtol=2e-5, atol=1e-6)
+
+
 def test_multi_step_training_reduces_loss():
     model, sync, state = _setup(8)
 
@@ -133,3 +156,22 @@ def test_multi_step_training_reduces_loss():
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.7
     assert int(state.step) == 30
+
+
+def test_debug_checks_catches_nan_at_the_offending_step():
+    """SURVEY.md §5.2: checkify float_checks raise at the step where the
+    NaN occurs (not later, not at a hook's convenience)."""
+    model = MLP(in_dim=20, hidden=16, num_classes=4)
+    mesh = local_mesh(8)
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1))
+    sync = SyncReplicas(model.loss, tx, mesh, debug_checks=True)
+    state = sync.init(model.init, seed=0)
+
+    good = _batch(0)
+    state, m = sync.step(state, sync.shard_batch(good))   # clean step: fine
+    assert np.isfinite(float(m["loss"]))
+
+    bad = {"x": good["x"].copy(), "y": good["y"]}
+    bad["x"][0, 0] = np.nan
+    with pytest.raises(Exception, match="(?i)nan"):
+        sync.step(state, sync.shard_batch(bad))
