@@ -1,0 +1,162 @@
+"""MoE-BERT: the BERT MLM encoder with Mixture-of-Experts FFN layers —
+the framework's expert-parallel model family (EP over the ``expert`` mesh
+axis; no MoE exists in the reference, SURVEY.md §2.5, so this is a
+capability extension, not parity).
+
+Every other FFN is replaced by a Switch-style MoE block (alternating
+dense/MoE, the GLaM/ST-MoE layout); the router's load-balancing aux loss
+is added to the MLM loss with weight ``aux_weight``. Expert weights are
+stacked [E, ...] and sharded over ``expert`` by ``sharding_rules``, so
+under jit the token dispatch/combine einsums become GSPMD-inserted
+collectives over the expert axis — the dense-dispatch analogue of the
+hand-written ``all_to_all`` EP path (ops/moe.py, tested equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..config import TrainConfig
+from ..ops import losses, moe, nn
+from ..parallel.mesh import AxisNames
+from ..parallel.sharding import ShardingRules
+from .base import register_model
+from .bert import Bert, BertConfig
+
+
+@dataclasses.dataclass
+class MoeBertConfig(BertConfig):
+    n_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    moe_every: int = 2            # MoE FFN every k-th layer (offset 1)
+    aux_weight: float = 0.01
+
+    @classmethod
+    def tiny(cls) -> "MoeBertConfig":
+        return cls(vocab_size=1000, hidden=128, layers=2, heads=4,
+                   intermediate=256, max_len=128, max_predictions=8,
+                   n_experts=4, capacity_factor=2.0)
+
+
+class MoeBert(Bert):
+    name = "moe_bert"
+
+    def __init__(self, cfg: MoeBertConfig, dtype=jnp.float32,
+                 attention_impl: str = "xla", attention_fn=None):
+        super().__init__(cfg, dtype=dtype, attention_impl=attention_impl,
+                         attention_fn=attention_fn)
+        self.cfg: MoeBertConfig = cfg
+
+    def _is_moe_layer(self, i: int) -> bool:
+        return (i % self.cfg.moe_every) == (self.cfg.moe_every - 1)
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        params = super().init(rng)
+        c = self.cfg
+        keys = jax.random.split(jax.random.fold_in(rng, 7777), c.layers)
+        for i in range(c.layers):
+            if self._is_moe_layer(i):
+                lp = params[f"layer_{i}"]
+                del lp["ffn"]
+                lp["moe"] = moe.moe_ffn_init(keys[i], c.n_experts, c.hidden,
+                                             c.intermediate)
+        return params
+
+    # ------------------------------------------------------------------
+    def encode(self, params, batch, rng=None, train: bool = False):
+        """Same block structure as Bert.encode with MoE FFNs swapped in;
+        collects the per-layer aux losses on ``self`` for loss()."""
+        c = self.cfg
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        types = batch.get("token_type_ids", jnp.zeros_like(ids))
+        mask = batch.get("attention_mask", jnp.ones_like(ids))
+
+        h = (nn.embedding(params["embed"]["word"], ids)
+             + nn.embedding(params["embed"]["pos"],
+                            jnp.arange(s, dtype=jnp.int32))[None]
+             + nn.embedding(params["embed"]["type"], types))
+        h = nn.layernorm(params["embed_ln"], h.astype(jnp.float32))
+        use_dropout = train and c.dropout > 0 and rng is not None
+        if use_dropout:
+            h = nn.dropout(jax.random.fold_in(rng, 1000), h, c.dropout,
+                           train=True)
+
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(c.layers):
+            lp = params[f"layer_{i}"]
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            a = self._attend(lp["attn"], h.astype(self.dtype), mask,
+                             lrng, train)
+            if use_dropout:
+                a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
+                               train=True)
+            h = nn.layernorm(lp["attn_ln"], (h + a.astype(jnp.float32)))
+            if self._is_moe_layer(i):
+                f, aux = moe.moe_ffn(lp["moe"], h.astype(self.dtype),
+                                     n_experts=c.n_experts, top_k=c.top_k,
+                                     capacity_factor=c.capacity_factor,
+                                     dtype=self.dtype)
+                aux_total = aux_total + aux
+            else:
+                f = nn.dense(lp["ffn"]["in"], h.astype(self.dtype),
+                             dtype=self.dtype)
+                f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+                f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
+            if use_dropout:
+                f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
+                               train=True)
+            h = nn.layernorm(lp["ffn_ln"], (h + f.astype(jnp.float32)))
+        self._last_aux = aux_total
+        return h
+
+    # ------------------------------------------------------------------
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng,
+                                        train=True)
+        w = batch["masked_weights"].astype(jnp.float32)
+        mlm = losses.softmax_xent_int_labels(
+            logits, batch["masked_labels"], where=w)
+        aux = self._last_aux
+        pred = jnp.argmax(logits, axis=-1)
+        acc = (jnp.sum((pred == batch["masked_labels"]) * w)
+               / jnp.maximum(jnp.sum(w), 1.0))
+        total = mlm + self.cfg.aux_weight * aux
+        return total, ({"mlm_accuracy": acc, "mlm_loss": mlm,
+                        "aux_loss": aux}, new_extras)
+
+    # ------------------------------------------------------------------
+    def sharding_rules(self, mesh_shape) -> ShardingRules:
+        """Bert's Megatron TP rules + expert-sharded MoE weights."""
+        E = AxisNames.EXPERT
+        base = super().sharding_rules(mesh_shape)
+        ep = getattr(mesh_shape, "expert", 1) if mesh_shape else 1
+        if ep <= 1:
+            return base
+        rules = [
+            (r"moe/w_(in|out)", P(E, None, None)),
+            (r"moe/b_(in|out)", P(E, None)),
+        ] + list(base.rules)
+        return ShardingRules(rules=rules,
+                             fsdp_axis_size=base.fsdp_axis_size)
+
+
+@register_model("moe_bert")
+def _make_moe_bert(config: TrainConfig) -> MoeBert:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    cfg = MoeBertConfig()
+    cfg.vocab_size = config.data.vocab_size
+    return MoeBert(cfg, dtype=dtype, attention_impl=config.attention_impl)
+
+
+@register_model("moe_bert_tiny")
+def _make_moe_bert_tiny(config: TrainConfig) -> MoeBert:
+    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
+    return MoeBert(MoeBertConfig.tiny(), dtype=dtype,
+                   attention_impl=config.attention_impl)
