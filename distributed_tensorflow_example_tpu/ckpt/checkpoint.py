@@ -15,7 +15,10 @@ Parity map (SURVEY.md §3.4/§5.4 → here):
 
 Format note: npz (zip of npy) keeps this dependency-free and inspectable;
 keys are ``/``-joined pytree paths. PRNG-key leaves are serialized via
-``jax.random.key_data`` and rewrapped on load.
+``jax.random.key_data`` and rewrapped on load. bfloat16 leaves (npy cannot
+represent ml_dtypes' bfloat16 — it round-trips as raw void) are stored as
+uint16 bit patterns under a ``__bf16__/`` key prefix and viewed back on
+load, so ``param_dtype=bfloat16`` states checkpoint losslessly.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ import time
 from typing import Any
 
 import jax
+import ml_dtypes
 import numpy as np
 
 from ..utils.pytree import is_prng_key as _is_key, path_str as _path_str
@@ -59,7 +63,11 @@ def _flatten(state: PyTree) -> dict[str, np.ndarray]:
         if _is_key(leaf):
             out["__prngkey__/" + key] = np.asarray(jax.random.key_data(leaf))
         else:
-            out[key] = _to_host(leaf)
+            arr = _to_host(leaf)
+            if arr.dtype == ml_dtypes.bfloat16:
+                out["__bf16__/" + key] = arr.view(np.uint16)
+            else:
+                out[key] = arr
     return out
 
 
@@ -69,16 +77,27 @@ def _unflatten(template: PyTree, arrays: dict[str, np.ndarray]) -> PyTree:
     for path, tleaf in paths_and_leaves:
         key = _path_str(path)
         if "__prngkey__/" + key in arrays:
-            leaf = jax.random.wrap_key_data(
-                np.asarray(arrays["__prngkey__/" + key]))
+            leaves.append(jax.random.wrap_key_data(
+                np.asarray(arrays["__prngkey__/" + key])))
+            continue
+        if "__bf16__/" + key in arrays:
+            leaf = arrays["__bf16__/" + key].view(ml_dtypes.bfloat16)
         elif key in arrays:
             leaf = arrays[key]
-            if hasattr(tleaf, "shape") and tuple(leaf.shape) != tuple(tleaf.shape):
-                raise ValueError(
-                    f"checkpoint leaf {key!r} shape {leaf.shape} != "
-                    f"template {tleaf.shape}")
         else:
             raise KeyError(f"checkpoint missing leaf {key!r}")
+        if hasattr(tleaf, "shape") and tuple(leaf.shape) != tuple(tleaf.shape):
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {leaf.shape} != "
+                f"template {tleaf.shape}")
+        if hasattr(tleaf, "dtype") and leaf.dtype != tleaf.dtype:
+            # a bf16 checkpoint restoring into an f32 run (or vice versa)
+            # would otherwise continue silently at the wrong precision —
+            # param_dtype must match across save and resume
+            raise ValueError(
+                f"checkpoint leaf {key!r} dtype {leaf.dtype} != template "
+                f"{tleaf.dtype}: restore with the same param_dtype the "
+                "checkpoint was written with")
         leaves.append(leaf)
     restored = jax.tree_util.tree_unflatten(treedef, leaves)
     # re-place on the template's shardings when it is device-resident
@@ -105,6 +124,10 @@ class CheckpointManager:
         self.keep_every_n_hours = keep_every_n_hours
         self.async_save = async_save
         self._lock = threading.Lock()
+        # guards the _pending slot itself: save()/wait() can race from the
+        # step-based and wall-clock saver threads (ADVICE r2); the write
+        # payloads stay serialized by _lock + the 1-worker executor
+        self._pending_lock = threading.Lock()
         self._pending: "Future | None" = None
         self._executor = None
         if async_save:
@@ -160,8 +183,9 @@ class CheckpointManager:
     def wait(self) -> None:
         """Block until an in-flight async write has landed (no-op when
         nothing is pending). Raises the writer thread's exception, if any."""
-        if self._pending is not None:
+        with self._pending_lock:
             pending, self._pending = self._pending, None
+        if pending is not None:
             pending.result()
 
     def close(self) -> None:
@@ -186,8 +210,15 @@ class CheckpointManager:
         if not self.is_writer:
             return None
         if self._executor is not None:
-            self.wait()   # depth-1 queue; surfaces previous write errors
-            self._pending = self._executor.submit(self._write, arrays, step)
+            # depth-1 queue: drain the previous write (surfacing its
+            # errors) and submit the new one under ONE lock hold, so two
+            # concurrent save() calls cannot both pass the drain and
+            # overwrite each other's Future
+            with self._pending_lock:
+                if self._pending is not None:
+                    self._pending.result()
+                self._pending = self._executor.submit(
+                    self._write, arrays, step)
             return self.checkpoint_path(step)
         return self._write(arrays, step)
 

@@ -32,7 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
                     "(distributed-tensorflow-example parity CLI)")
     add_legacy_flags(p)
     p.add_argument("--model", default="mlp",
-                   help="mlp | lenet | resnet20 | resnet50 | bert")
+                   help="mlp | lenet | resnet20 | resnet50 | bert | "
+                        "bert_tiny | moe_bert | moe_bert_tiny")
     p.add_argument("--dataset", default=None,
                    help="default: the model's canonical dataset")
     p.add_argument("--data_dir", default=None,
@@ -64,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--accum_steps", type=int, default=1)
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"])
+    p.add_argument("--param_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="parameter storage dtype (f32 default; bf16 halves "
+                        "param/optimizer HBM at some precision cost)")
     p.add_argument("--mesh", default="",
                    help="axis sizes, e.g. 'data=4,model=2' (default: all "
                         "devices on the data axis)")
@@ -126,6 +131,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         steps_per_loop=args.steps_per_loop,
         seed=args.seed,
         dtype=args.dtype,
+        param_dtype=args.param_dtype,
         attention_impl=args.attention,
         mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
         data=DataConfig(dataset=args.dataset or args.model,
@@ -180,7 +186,7 @@ def load_dataset(cfg: TrainConfig, model=None):
         from ..data.imagenet import get_imagenet
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)
-    elif name in ("bert", "bert_tiny"):
+    elif name in ("bert", "bert_tiny", "moe_bert", "moe_bert_tiny"):
         from ..data.bert_data import get_bert_data
         # take vocab/prediction shapes from the MODEL so data and logits
         # can never diverge (out-of-range labels clamp silently under jit)

@@ -63,7 +63,9 @@ class SyncConfig:
     """
 
     replicas_to_aggregate: int | None = None  # None => data-axis size
-    total_num_replicas: int | None = None     # backup replicas have no TPU analogue
+    total_num_replicas: int | None = None     # must equal replicas_to_aggregate:
+                                              # backup replicas have no TPU
+                                              # analogue (hard error otherwise)
     accum_steps: int = 1                      # microbatch accumulation inside the step
     mode: str = "auto"                        # auto (jit+sharding) | shard_map (explicit psum)
 

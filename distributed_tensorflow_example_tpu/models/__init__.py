@@ -8,5 +8,6 @@ from . import mlp as mlp          # registers "mlp"
 from . import lenet as lenet      # registers "lenet"
 from . import resnet as resnet    # registers "resnet20", "resnet50"
 from . import bert as bert        # registers "bert", "bert_tiny"
+from . import moe as moe          # registers "moe_bert", "moe_bert_tiny"
 
 __all__ = ["Model", "get_model", "list_models", "register_model"]

@@ -22,9 +22,29 @@ from __future__ import annotations
 from typing import Any, Callable, Protocol
 
 import jax
+import jax.numpy as jnp
 
 from ..config import TrainConfig
 from ..parallel.sharding import ShardingRules
+
+
+def resolve_dtype(name: str):
+    """Config dtype string -> jnp dtype (the framework's two-dtype policy:
+    bf16 feeds the MXU, f32 everywhere precision matters)."""
+    return jnp.bfloat16 if name == "bfloat16" else jnp.float32
+
+
+def cast_floating(tree, dtype):
+    """Cast floating-point leaves to ``dtype`` (int/bool leaves untouched).
+
+    Models apply this to their freshly-initialized params so
+    ``TrainConfig.param_dtype`` governs parameter storage dtype uniformly;
+    initializers compute in f32 first, so this matches passing
+    ``param_dtype`` into every ``ops.nn.*_init`` call."""
+    def c(x):
+        return (x.astype(dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x)
+    return jax.tree_util.tree_map(c, tree)
 
 
 class Model(Protocol):

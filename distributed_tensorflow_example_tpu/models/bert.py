@@ -34,7 +34,7 @@ from ..ops import losses, nn
 from ..ops.attention import multi_head_attention
 from ..parallel.mesh import AxisNames
 from ..parallel.sharding import ShardingRules
-from .base import register_model
+from .base import cast_floating, register_model, resolve_dtype
 
 
 @dataclasses.dataclass
@@ -65,10 +65,12 @@ class Bert:
 
     def __init__(self, cfg: BertConfig, dtype=jnp.float32,
                  attention_impl: str = "xla",
-                 attention_fn: Callable | None = None):
+                 attention_fn: Callable | None = None,
+                 param_dtype=jnp.float32):
         assert cfg.hidden % cfg.heads == 0
         self.cfg = cfg
         self.dtype = dtype
+        self.param_dtype = param_dtype
         self.attention_impl = attention_impl
         # override hook: e.g. make_ring_attention(mesh) for seq parallelism
         self.attention_fn = attention_fn
@@ -115,7 +117,7 @@ class Bert:
             # decoder kernel is TIED to embed/word/table; only a bias here
             "bias": jnp.zeros((c.vocab_size,), jnp.float32),
         }
-        return params
+        return cast_floating(params, self.param_dtype)
 
     # ------------------------------------------------------------------
     def _attend(self, p, h, mask, rng, train):
@@ -260,14 +262,15 @@ class Bert:
 
 @register_model("bert")
 def _make_bert(config: TrainConfig) -> Bert:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     cfg = BertConfig.base()
     cfg.vocab_size = config.data.vocab_size
-    return Bert(cfg, dtype=dtype, attention_impl=config.attention_impl)
+    return Bert(cfg, dtype=resolve_dtype(config.dtype),
+                attention_impl=config.attention_impl,
+                param_dtype=resolve_dtype(config.param_dtype))
 
 
 @register_model("bert_tiny")
 def _make_bert_tiny(config: TrainConfig) -> Bert:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-    return Bert(BertConfig.tiny(), dtype=dtype,
-                attention_impl=config.attention_impl)
+    return Bert(BertConfig.tiny(), dtype=resolve_dtype(config.dtype),
+                attention_impl=config.attention_impl,
+                param_dtype=resolve_dtype(config.param_dtype))

@@ -15,27 +15,29 @@ import numpy as np
 
 from ..config import TrainConfig
 from ..ops import losses, nn
-from .base import DefaultRulesMixin, register_model
+from .base import (DefaultRulesMixin, cast_floating, register_model,
+                   resolve_dtype)
 
 
 class LeNet(DefaultRulesMixin):
     name = "lenet"
 
     def __init__(self, num_classes: int = 10, dropout_rate: float = 0.0,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, param_dtype=jnp.float32):
         self.num_classes = num_classes
         self.dropout_rate = dropout_rate
         self.dtype = dtype
+        self.param_dtype = param_dtype
 
     def init(self, rng: jax.Array):
         r = jax.random.split(rng, 4)
-        return {
+        return cast_floating({
             "conv1": nn.conv2d_init(r[0], 5, 5, 1, 32),
             "conv2": nn.conv2d_init(r[1], 5, 5, 32, 64),
             "fc1": nn.dense_init(r[2], 7 * 7 * 64, 512, init="he"),
             "fc2": nn.dense_init(r[3], 512, self.num_classes,
                                  init="truncated_normal"),
-        }
+        }, self.param_dtype)
 
     def apply(self, params, extras, batch, rng=None, train: bool = False):
         x = batch["x"]
@@ -76,5 +78,5 @@ class LeNet(DefaultRulesMixin):
 
 @register_model("lenet")
 def _make_lenet(config: TrainConfig) -> LeNet:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-    return LeNet(dtype=dtype)
+    return LeNet(dtype=resolve_dtype(config.dtype),
+                 param_dtype=resolve_dtype(config.param_dtype))

@@ -14,23 +14,26 @@ import numpy as np
 
 from ..config import TrainConfig
 from ..ops import losses, nn
-from .base import DefaultRulesMixin, register_model
+from .base import (DefaultRulesMixin, cast_floating, register_model,
+                   resolve_dtype)
 
 
 class MLP(DefaultRulesMixin):
     name = "mlp"
 
     def __init__(self, in_dim: int = 784, hidden: int = 100,
-                 num_classes: int = 10, dtype=jnp.float32):
+                 num_classes: int = 10, dtype=jnp.float32,
+                 param_dtype=jnp.float32):
         self.in_dim, self.hidden, self.num_classes = in_dim, hidden, num_classes
         self.dtype = dtype
+        self.param_dtype = param_dtype
 
     def init(self, rng: jax.Array):
         r1, r2 = jax.random.split(rng)
-        return {
+        return cast_floating({
             "fc1": nn.dense_init(r1, self.in_dim, self.hidden),
             "fc2": nn.dense_init(r2, self.hidden, self.num_classes),
-        }
+        }, self.param_dtype)
 
     def apply(self, params, extras, batch, rng=None, train: bool = False):
         x = batch["x"].reshape((batch["x"].shape[0], -1))
@@ -62,5 +65,5 @@ class MLP(DefaultRulesMixin):
 
 @register_model("mlp")
 def _make_mlp(config: TrainConfig) -> MLP:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-    return MLP(dtype=dtype)
+    return MLP(dtype=resolve_dtype(config.dtype),
+               param_dtype=resolve_dtype(config.param_dtype))

@@ -9,7 +9,8 @@ is added to the MLM loss with weight ``aux_weight``. Expert weights are
 stacked [E, ...] and sharded over ``expert`` by ``sharding_rules``, so
 under jit the token dispatch/combine einsums become GSPMD-inserted
 collectives over the expert axis — the dense-dispatch analogue of the
-hand-written ``all_to_all`` EP path (ops/moe.py, tested equivalent).
+hand-written ``all_to_all`` EP path (ops/moe.py; equivalence asserted in
+tests/test_moe.py).
 """
 
 from __future__ import annotations
@@ -24,7 +25,7 @@ from ..config import TrainConfig
 from ..ops import losses, moe, nn
 from ..parallel.mesh import AxisNames
 from ..parallel.sharding import ShardingRules
-from .base import register_model
+from .base import register_model, resolve_dtype
 from .bert import Bert, BertConfig
 
 
@@ -47,9 +48,10 @@ class MoeBert(Bert):
     name = "moe_bert"
 
     def __init__(self, cfg: MoeBertConfig, dtype=jnp.float32,
-                 attention_impl: str = "xla", attention_fn=None):
+                 attention_impl: str = "xla", attention_fn=None,
+                 param_dtype=jnp.float32):
         super().__init__(cfg, dtype=dtype, attention_impl=attention_impl,
-                         attention_fn=attention_fn)
+                         attention_fn=attention_fn, param_dtype=param_dtype)
         self.cfg: MoeBertConfig = cfg
 
     def _is_moe_layer(self, i: int) -> bool:
@@ -65,13 +67,20 @@ class MoeBert(Bert):
                 lp = params[f"layer_{i}"]
                 del lp["ffn"]
                 lp["moe"] = moe.moe_ffn_init(keys[i], c.n_experts, c.hidden,
-                                             c.intermediate)
+                                             c.intermediate,
+                                             param_dtype=self.param_dtype)
         return params
 
     # ------------------------------------------------------------------
     def encode(self, params, batch, rng=None, train: bool = False):
+        h, _ = self.encode_with_aux(params, batch, rng, train)
+        return h
+
+    def encode_with_aux(self, params, batch, rng=None, train: bool = False):
         """Same block structure as Bert.encode with MoE FFNs swapped in;
-        collects the per-layer aux losses on ``self`` for loss()."""
+        returns ``(seq_out, aux_total)`` — the summed per-layer router
+        load-balancing losses ride the return path (never stored on
+        ``self``: a tracer on a long-lived object leaks across traces)."""
         c = self.cfg
         ids = batch["input_ids"]
         b, s = ids.shape
@@ -113,17 +122,16 @@ class MoeBert(Bert):
                 f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
                                train=True)
             h = nn.layernorm(lp["ffn_ln"], (h + f.astype(jnp.float32)))
-        self._last_aux = aux_total
-        return h
+        return h, aux_total
 
     # ------------------------------------------------------------------
     def loss(self, params, extras, batch, rng):
-        logits, new_extras = self.apply(params, extras, batch, rng,
-                                        train=True)
+        seq_out, aux = self.encode_with_aux(params, batch, rng, train=True)
+        logits = self.mlm_logits(params, seq_out, batch["masked_positions"])
+        new_extras = extras
         w = batch["masked_weights"].astype(jnp.float32)
         mlm = losses.softmax_xent_int_labels(
             logits, batch["masked_labels"], where=w)
-        aux = self._last_aux
         pred = jnp.argmax(logits, axis=-1)
         acc = (jnp.sum((pred == batch["masked_labels"]) * w)
                / jnp.maximum(jnp.sum(w), 1.0))
@@ -149,14 +157,15 @@ class MoeBert(Bert):
 
 @register_model("moe_bert")
 def _make_moe_bert(config: TrainConfig) -> MoeBert:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     cfg = MoeBertConfig()
     cfg.vocab_size = config.data.vocab_size
-    return MoeBert(cfg, dtype=dtype, attention_impl=config.attention_impl)
+    return MoeBert(cfg, dtype=resolve_dtype(config.dtype),
+                   attention_impl=config.attention_impl,
+                   param_dtype=resolve_dtype(config.param_dtype))
 
 
 @register_model("moe_bert_tiny")
 def _make_moe_bert_tiny(config: TrainConfig) -> MoeBert:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
-    return MoeBert(MoeBertConfig.tiny(), dtype=dtype,
-                   attention_impl=config.attention_impl)
+    return MoeBert(MoeBertConfig.tiny(), dtype=resolve_dtype(config.dtype),
+                   attention_impl=config.attention_impl,
+                   param_dtype=resolve_dtype(config.param_dtype))

@@ -19,7 +19,8 @@ import numpy as np
 
 from ..config import TrainConfig
 from ..ops import losses, nn
-from .base import DefaultRulesMixin, register_model
+from .base import (DefaultRulesMixin, cast_floating, register_model,
+                   resolve_dtype)
 
 
 def _bn_apply(params, extras, x, *, train, momentum=0.9):
@@ -122,7 +123,8 @@ class ResNet(DefaultRulesMixin):
 
     def __init__(self, name: str, block, stage_sizes: Sequence[int],
                  widths: Sequence[int], num_classes: int,
-                 input_hw: int, imagenet_stem: bool, dtype=jnp.float32):
+                 input_hw: int, imagenet_stem: bool, dtype=jnp.float32,
+                 param_dtype=jnp.float32):
         self.name = name
         self.block = block
         self.stage_sizes = list(stage_sizes)
@@ -131,6 +133,7 @@ class ResNet(DefaultRulesMixin):
         self.input_hw = input_hw
         self.imagenet_stem = imagenet_stem
         self.dtype = dtype
+        self.param_dtype = param_dtype
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array):
@@ -159,7 +162,8 @@ class ResNet(DefaultRulesMixin):
 
         params["fc"] = nn.dense_init(keys[next(ki)], ch, self.num_classes,
                                      init="truncated_normal")
-        return params, extras
+        # extras (BN running stats) stay f32: they accumulate across steps
+        return cast_floating(params, self.param_dtype), extras
 
     # ------------------------------------------------------------------
     def apply(self, params, extras, batch, rng=None, train: bool = False):
@@ -212,15 +216,15 @@ class ResNet(DefaultRulesMixin):
 
 @register_model("resnet20")
 def _make_resnet20(config: TrainConfig) -> ResNet:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     return ResNet("resnet20", _BasicBlock, [3, 3, 3], [16, 32, 64],
                   num_classes=10, input_hw=32, imagenet_stem=False,
-                  dtype=dtype)
+                  dtype=resolve_dtype(config.dtype),
+                  param_dtype=resolve_dtype(config.param_dtype))
 
 
 @register_model("resnet50")
 def _make_resnet50(config: TrainConfig) -> ResNet:
-    dtype = jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32
     return ResNet("resnet50", _BottleneckBlock, [3, 4, 6, 3],
                   [64, 128, 256, 512], num_classes=1000, input_hw=224,
-                  imagenet_stem=True, dtype=dtype)
+                  imagenet_stem=True, dtype=resolve_dtype(config.dtype),
+                  param_dtype=resolve_dtype(config.param_dtype))

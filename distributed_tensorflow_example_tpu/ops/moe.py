@@ -62,9 +62,23 @@ def moe_ffn_init(rng: jax.Array, n_experts: int, hidden: int,
     }
 
 
+def aux_loss(frac_tokens: jax.Array, mean_probs: jax.Array,
+             n_experts: int, k: int) -> jax.Array:
+    """Switch load-balancing loss from routing statistics.
+
+    Separated from :func:`_route` so the shard_map EP path can pmean the
+    statistics over the expert axis FIRST and apply the formula to the
+    global values — making dense and explicit-EP aux agree exactly (the
+    formula is nonlinear in its inputs, so pmean(aux(local)) !=
+    aux(pmean(local)))."""
+    return n_experts * jnp.sum(frac_tokens / k * mean_probs)
+
+
 def _route(router_params: Params, x2: jax.Array, n_experts: int, k: int,
            capacity: int):
-    """x2: [T, D] -> (dispatch [T,E,C], combine [T,E,C], aux_loss).
+    """x2: [T, D] -> (dispatch [T,E,C], combine [T,E,C],
+    (frac_tokens [E], mean_probs [E])) — callers turn the statistics into
+    the load-balancing loss via :func:`aux_loss`.
 
     Top-k by repeated masked argmax; per-expert slot positions via cumsum
     (all static shapes — no sort, no gather, TPU-friendly).
@@ -94,11 +108,10 @@ def _route(router_params: Params, x2: jax.Array, n_experts: int, k: int,
         total_assigned = total_assigned + onehot
         remaining = remaining * (1.0 - onehot)              # mask the chosen
 
-    # Switch load-balance loss over FIRST-choice assignment fractions
+    # routing statistics for the Switch load-balance loss
     frac_tokens = total_assigned.mean(0)                    # [E]
     mean_probs = probs.mean(0)
-    aux = n_experts * jnp.sum(frac_tokens / k * mean_probs)
-    return dispatch, combine, aux
+    return dispatch, combine, (frac_tokens, mean_probs)
 
 
 def _expert_compute(params: Params, inp: jax.Array, dtype) -> jax.Array:
@@ -127,8 +140,9 @@ def moe_ffn(params: Params, x: jax.Array, *, n_experts: int, top_k: int = 1,
     t = b * s
     cap = capacity_for(t, n_experts, capacity_factor)
     x2 = x.reshape(t, d)
-    dispatch, combine, aux = _route(params["router"], x2, n_experts,
-                                    top_k, cap)
+    dispatch, combine, (frac, mp) = _route(params["router"], x2, n_experts,
+                                           top_k, cap)
+    aux = aux_loss(frac, mp, n_experts, top_k)
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype),
                            x2.astype(dtype),
                            preferred_element_type=jnp.float32)
@@ -147,10 +161,12 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
     axis, weights sharded one-expert-group-per-rank, exchange via
     ``lax.all_to_all`` (the EP collective; parallel/collectives.py).
 
-    Semantics match :func:`moe_ffn` exactly when every rank computes the
-    same routing (capacity is per-(source rank, expert) here, so results
-    are identical only when no token is dropped — use a generous
-    capacity_factor when asserting parity).
+    Output semantics match :func:`moe_ffn` exactly when no token is
+    dropped (capacity is per-(source rank, expert) here, so use a
+    generous capacity_factor when asserting parity). The aux loss is
+    computed from routing statistics pmean'd over the expert axis — i.e.
+    from GLOBAL-batch fractions — so it matches the dense path's aux too
+    (see :func:`aux_loss`; asserted in tests/test_moe.py).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -158,6 +174,8 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
     if n_experts % n_ranks:
         raise ValueError(f"{n_experts} experts not divisible over "
                          f"{n_ranks} '{axis_name}' ranks")
+    batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
+    stat_axes = batch_axes + (axis_name,)
 
     e_local = n_experts // n_ranks
 
@@ -168,8 +186,8 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
         tl = bl * sl
         x2 = x_local.reshape(tl, dl)
         cap = capacity_for(tl, n_experts, capacity_factor)
-        dispatch, combine, aux = _route(p_local["router"], x2, n_experts,
-                                        top_k, cap)
+        dispatch, combine, (frac, mp) = _route(p_local["router"], x2,
+                                               n_experts, top_k, cap)
         send = jnp.einsum("tec,td->ecd", dispatch.astype(dtype),
                           x2.astype(dtype),
                           preferred_element_type=jnp.float32)   # [E, C, D]
@@ -189,7 +207,11 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
         got = lax.all_to_all(back.astype(jnp.float32), axis_name,
                              split_axis=0, concat_axis=0, tiled=True)
         y = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32), got)
-        aux = lax.pmean(aux, axis_name)
+        # global-batch aux: pmean the statistics over every axis the
+        # tokens are sharded on, then apply the formula (equal-size token
+        # shards make pmean == the global batch mean)
+        aux = aux_loss(lax.pmean(frac, stat_axes),
+                       lax.pmean(mp, stat_axes), n_experts, top_k)
         return y.reshape(bl, sl, dl).astype(x_local.dtype), aux
 
     xspec = P(batch_axes, axis_name, None)

@@ -143,6 +143,14 @@ class SyncReplicas:
                 f"({self.num_replicas}) on TPU: partial aggregation has no "
                 "SPMD analogue (reference backup-replica semantics dropped, "
                 "see module docstring)")
+        if (self.sync.total_num_replicas is not None
+                and self.sync.total_num_replicas != self.num_replicas):
+            raise ValueError(
+                "total_num_replicas != replicas_to_aggregate (backup "
+                f"replicas; got {self.sync.total_num_replicas} vs "
+                f"{self.num_replicas}) has no TPU analogue: ICI topology "
+                "is fixed, so spare replicas cannot exist (reference "
+                "backup-replica semantics dropped, see module docstring)")
         if self.sync.mode not in ("auto", "shard_map"):
             raise ValueError(f"unknown sync mode {self.sync.mode!r}")
 

@@ -127,3 +127,19 @@ def test_async_save_end_to_end_resume(tmp_path):
         tr.train()
     mgr = CheckpointManager(str(tmp_path))
     assert mgr.latest_step() == 20
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    """param_dtype=bfloat16 states checkpoint losslessly: npy cannot
+    store ml_dtypes bfloat16 (it degrades to raw void), so bf16 leaves
+    ride as uint16 bit patterns under a __bf16__/ key prefix."""
+    mgr = CheckpointManager(str(tmp_path))
+    state = {"w": jnp.arange(8, dtype=jnp.bfloat16) * 0.125,
+             "b": jnp.ones((3,), jnp.float32),
+             "step": jnp.asarray(3, jnp.int32)}
+    mgr.save(state, step=3)
+    out = mgr.restore(jax.tree_util.tree_map(jnp.zeros_like, state), step=3)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
+                                  np.asarray(state["w"], np.float32))
