@@ -32,8 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
                     "(distributed-tensorflow-example parity CLI)")
     add_legacy_flags(p)
     p.add_argument("--model", default="mlp",
-                   help="mlp | lenet | resnet20 | resnet50 | bert | "
-                        "bert_tiny | moe_bert | moe_bert_tiny")
+                   help="mlp | pipe_mlp | lenet | resnet20 | resnet50 | "
+                        "bert | bert_tiny | moe_bert | moe_bert_tiny")
     p.add_argument("--dataset", default=None,
                    help="default: the model's canonical dataset")
     p.add_argument("--data_dir", default=None,
@@ -174,7 +174,7 @@ def load_dataset(cfg: TrainConfig, model=None):
     mlp/lenet → MNIST, resnet20 → CIFAR-10, resnet50 → ImageNet.
     """
     name = cfg.data.dataset
-    if name in ("mlp", "mnist", "lenet"):
+    if name in ("mlp", "pipe_mlp", "mnist", "lenet"):
         from ..data.mnist import get_mnist
         # arrays stay flat-784; models normalize input shape themselves
         # (mlp flattens, lenet reshapes to NHWC)

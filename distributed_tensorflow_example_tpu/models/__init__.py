@@ -9,5 +9,6 @@ from . import lenet as lenet      # registers "lenet"
 from . import resnet as resnet    # registers "resnet20", "resnet50"
 from . import bert as bert        # registers "bert", "bert_tiny"
 from . import moe as moe          # registers "moe_bert", "moe_bert_tiny"
+from . import pipe_mlp as pipe_mlp  # registers "pipe_mlp"
 
 __all__ = ["Model", "get_model", "list_models", "register_model"]

@@ -1,0 +1,145 @@
+"""Pipeline-parallel MNIST MLP — the PP demonstration model.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5: PP absent, not
+required for parity); this model exists so the ``pipe`` mesh axis is a
+delivered capability rather than a reserved name. Architecture: the
+reference-parity MLP's input/output projections (784→H, H→10) wrapped
+around a stack of L identical residual blocks ``h + relu(h·W + b)`` —
+homogeneous blocks are what make GPipe stages SPMD-able
+(:mod:`~distributed_tensorflow_example_tpu.parallel.pipeline`).
+
+Unbound (no mesh, or ``pipe == 1``) the stack runs as a plain ``lax.scan``
+on one device — bit-identical math to the pipelined run, which is exactly
+the parity claim tests assert (pipelined == sequential for outputs, loss,
+and gradients).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..config import TrainConfig
+from ..ops import losses, nn
+from ..parallel.mesh import AxisNames
+from ..parallel.pipeline import make_pipeline
+from ..parallel.sharding import ShardingRules
+from .base import cast_floating, register_model, resolve_dtype
+
+
+@dataclasses.dataclass
+class PipeMlpConfig:
+    in_dim: int = 784
+    hidden: int = 128
+    blocks: int = 4            # total residual blocks, split over pipe
+    num_classes: int = 10
+    microbatches: int = 4      # GPipe M (per data shard)
+
+
+def _block_scan(stacked, x, dtype):
+    """Apply stacked residual blocks in order: the pipeline stage_fn (on a
+    [L/P]-leaf shard) and the sequential oracle (on the full [L] stack)."""
+    def body(h, blk):
+        y = jax.lax.dot_general(
+            h.astype(dtype), blk["kernel"].astype(dtype),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return h + jax.nn.relu(y + blk["bias"].astype(jnp.float32)), None
+    out, _ = jax.lax.scan(body, x, stacked)
+    return out
+
+
+class PipeMlp:
+    name = "pipe_mlp"
+
+    def __init__(self, cfg: PipeMlpConfig | None = None, dtype=jnp.float32,
+                 param_dtype=jnp.float32):
+        self.cfg = cfg or PipeMlpConfig()
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+        self._pipelined = None     # bound by bind_mesh when pipe > 1
+
+    # ------------------------------------------------------------------
+    def bind_mesh(self, mesh) -> None:
+        """Attach a mesh; a pipe axis > 1 activates GPipe execution.
+
+        The Trainer calls this for any model that defines it (mirroring how
+        ring attention binds a mesh via ``attention_fn``)."""
+        if mesh is not None and mesh.shape[AxisNames.PIPE] > 1:
+            if self.cfg.blocks % mesh.shape[AxisNames.PIPE]:
+                raise ValueError(
+                    f"blocks={self.cfg.blocks} not divisible by pipe axis "
+                    f"size {mesh.shape[AxisNames.PIPE]}")
+            self._pipelined = make_pipeline(
+                mesh, lambda p, x: _block_scan(p, x, self.dtype),
+                num_microbatches=self.cfg.microbatches)
+        else:
+            self._pipelined = None
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        c = self.cfg
+        r_in, r_blk, r_out = jax.random.split(rng, 3)
+        blk_keys = jax.random.split(r_blk, c.blocks)
+        kernels = jnp.stack([
+            nn.glorot_uniform(k, (c.hidden, c.hidden), jnp.float32,
+                              c.hidden, c.hidden) for k in blk_keys])
+        return cast_floating({
+            "in_proj": nn.dense_init(r_in, c.in_dim, c.hidden),
+            "blocks": {"kernel": kernels,
+                       "bias": jnp.zeros((c.blocks, c.hidden), jnp.float32)},
+            "out_proj": nn.dense_init(r_out, c.hidden, c.num_classes),
+        }, self.param_dtype)
+
+    def apply(self, params, extras, batch, rng=None, train: bool = False):
+        x = batch["x"].reshape((batch["x"].shape[0], -1))
+        h = jax.nn.relu(nn.dense(params["in_proj"], x, dtype=self.dtype))
+        if self._pipelined is not None:
+            h = self._pipelined(params["blocks"], h)
+        else:
+            h = _block_scan(params["blocks"], h, self.dtype)
+        logits = nn.dense(params["out_proj"], h, dtype=self.dtype)
+        return logits, extras
+
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng,
+                                        train=True)
+        loss = losses.softmax_xent_int_labels(logits, batch["y"])
+        aux = {"accuracy": losses.accuracy(logits, batch["y"])}
+        return loss, (aux, new_extras)
+
+    def eval_metrics(self, params, extras, batch) -> dict:
+        logits, _ = self.apply(params, extras, batch, train=False)
+        return {
+            "loss": losses.softmax_xent_int_labels(logits, batch["y"]),
+            "accuracy": losses.accuracy(logits, batch["y"]),
+        }
+
+    # ------------------------------------------------------------------
+    def sharding_rules(self, mesh_shape) -> ShardingRules:
+        """Block stack sharded over pipe (stage placement); everything
+        else replicated/fsdp per the default policy."""
+        fsdp = getattr(mesh_shape, "fsdp", 1) if mesh_shape else 1
+        pipe = getattr(mesh_shape, "pipe", 1) if mesh_shape else 1
+        if pipe <= 1:
+            return ShardingRules(fsdp_axis_size=fsdp)
+        return ShardingRules(rules=[
+            (r"blocks/(kernel|bias)", P(AxisNames.PIPE)),
+        ], fsdp_axis_size=fsdp)
+
+    def dummy_batch(self, batch_size: int):
+        rs = np.random.RandomState(0)
+        return {
+            "x": rs.rand(batch_size, self.cfg.in_dim).astype(np.float32),
+            "y": rs.randint(0, self.cfg.num_classes, size=(batch_size,),
+                            dtype=np.int32),
+        }
+
+
+@register_model("pipe_mlp")
+def _make_pipe_mlp(config: TrainConfig) -> PipeMlp:
+    return PipeMlp(dtype=resolve_dtype(config.dtype),
+                   param_dtype=resolve_dtype(config.param_dtype))

@@ -19,7 +19,10 @@ pipe      pipeline-parallel stages
 
 Only ``data`` is required for reference parity; the rest exist so every
 model and step function in the framework is written against the full axis
-set from day one and scaling is a config change, not a rewrite.
+set from day one and scaling is a config change, not a rewrite. Every axis
+is load-bearing: fsdp via the default sharding rules, model via BERT's
+Megatron rules, seq via ring attention, expert via MoE all_to_all, and
+pipe via GPipe microbatch pipelining (:mod:`.pipeline`).
 """
 
 from __future__ import annotations

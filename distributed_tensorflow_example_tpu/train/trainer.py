@@ -66,6 +66,10 @@ class Trainer:
         self.train_arrays = train_arrays
         self.eval_arrays = eval_arrays
 
+        if hasattr(model, "bind_mesh"):
+            # mesh-aware models (pipeline stages; mirrors how ring
+            # attention binds a mesh via attention_fn)
+            model.bind_mesh(self.mesh)
         self.tx = make_optimizer(config.optimizer)
         rules = model.sharding_rules(config.mesh)
         self.sync = SyncReplicas(model.loss, self.tx, self.mesh,
