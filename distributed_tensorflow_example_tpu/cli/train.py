@@ -106,6 +106,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile_dir", default=None)
     p.add_argument("--profile_steps", default=None,
                    help="start,stop step range for the profiler hook")
+    p.add_argument("--step_timing", action="store_true",
+                   help="record per-dispatch device-time percentiles + "
+                        "compiled-step flops/bytes to the metrics JSONL "
+                        "(WorkerCacheLogger parity; blocks the dispatch "
+                        "queue per step)")
     return p
 
 
@@ -163,7 +168,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             debug_checks=args.debug_checks,
             debug_nans=args.debug_nans,
             profile_dir=args.profile_dir,
-            profile_steps=profile_steps),
+            profile_steps=profile_steps,
+            step_timing=args.step_timing),
     )
 
 

@@ -120,6 +120,11 @@ class ObservabilityConfig:
     debug_checks: bool = False        # checkify float_checks around the step
                                       # (SURVEY.md §5.2); debug-only cost
     debug_nans: bool = False          # jax.config jax_debug_nans flag
+    step_timing: bool = False         # per-dispatch device-time records +
+                                      # compiled-step cost analysis in the
+                                      # metrics JSONL (WorkerCacheLogger
+                                      # parity, SURVEY.md §2.4/§5.1);
+                                      # blocks the dispatch queue per step
 
 
 @dataclasses.dataclass

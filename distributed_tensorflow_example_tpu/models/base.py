@@ -47,6 +47,22 @@ def cast_floating(tree, dtype):
     return jax.tree_util.tree_map(c, tree)
 
 
+def classification_eval_metrics(logits, batch) -> dict:
+    """Shared eval_metrics body for integer-label classifiers.
+
+    Honors an optional ``batch["__valid__"]`` example mask (1.0 = real
+    example, 0.0 = padding) so the Trainer can pad the eval tail batch to a
+    static shape — one compiled executable for the whole eval pass instead
+    of a recompile per distinct tail size (SURVEY.md §2.3 static-shape
+    discipline)."""
+    from ..ops import losses
+    w = batch.get("__valid__")
+    return {
+        "loss": losses.softmax_xent_int_labels(logits, batch["y"], where=w),
+        "accuracy": losses.accuracy(logits, batch["y"], where=w),
+    }
+
+
 class Model(Protocol):
     name: str
 

@@ -215,6 +215,11 @@ class Bert:
     def eval_metrics(self, params, extras, batch) -> dict:
         logits, _ = self.apply(params, extras, batch, train=False)
         w = batch["masked_weights"].astype(jnp.float32)
+        valid = batch.get("__valid__")
+        if valid is not None:
+            # padded static-shape eval tail: zero out every token of a
+            # padding example; composes with the per-token MLM weights
+            w = w * valid.astype(jnp.float32)[:, None]
         pred = jnp.argmax(logits, axis=-1)
         return {
             "loss": losses.softmax_xent_int_labels(

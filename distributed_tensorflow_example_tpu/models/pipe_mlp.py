@@ -28,7 +28,8 @@ from ..ops import losses, nn
 from ..parallel.mesh import AxisNames
 from ..parallel.pipeline import make_pipeline
 from ..parallel.sharding import ShardingRules
-from .base import cast_floating, register_model, resolve_dtype
+from .base import (cast_floating, classification_eval_metrics,
+                   register_model, resolve_dtype)
 
 
 @dataclasses.dataclass
@@ -113,10 +114,7 @@ class PipeMlp:
 
     def eval_metrics(self, params, extras, batch) -> dict:
         logits, _ = self.apply(params, extras, batch, train=False)
-        return {
-            "loss": losses.softmax_xent_int_labels(logits, batch["y"]),
-            "accuracy": losses.accuracy(logits, batch["y"]),
-        }
+        return classification_eval_metrics(logits, batch)
 
     # ------------------------------------------------------------------
     def sharding_rules(self, mesh_shape) -> ShardingRules:

@@ -19,7 +19,8 @@ import numpy as np
 
 from ..config import TrainConfig
 from ..ops import losses, nn
-from .base import (DefaultRulesMixin, cast_floating, register_model,
+from .base import (DefaultRulesMixin, cast_floating,
+                   classification_eval_metrics, register_model,
                    resolve_dtype)
 
 
@@ -199,10 +200,7 @@ class ResNet(DefaultRulesMixin):
 
     def eval_metrics(self, params, extras, batch) -> dict:
         logits, _ = self.apply(params, extras, batch, train=False)
-        return {
-            "loss": losses.softmax_xent_int_labels(logits, batch["y"]),
-            "accuracy": losses.accuracy(logits, batch["y"]),
-        }
+        return classification_eval_metrics(logits, batch)
 
     def dummy_batch(self, batch_size: int):
         rs = np.random.RandomState(0)

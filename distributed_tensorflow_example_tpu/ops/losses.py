@@ -46,6 +46,12 @@ def l2_regularization(params, scale: float) -> jax.Array:
     return scale * sum(jnp.sum(jnp.square(x)) for x in leaves)
 
 
-def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """labels: integer classes. Returns mean accuracy (f32 scalar)."""
-    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+def accuracy(logits: jax.Array, labels: jax.Array,
+             *, where=None) -> jax.Array:
+    """labels: integer classes. Returns mean accuracy (f32 scalar);
+    ``where`` (example weights) restricts the mean — used by the padded
+    static-shape eval tail."""
+    hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    if where is not None:
+        return jnp.sum(hit * where) / jnp.maximum(jnp.sum(where), 1.0)
+    return jnp.mean(hit)

@@ -136,6 +136,7 @@ class SyncReplicas:
         self.rules = rules or ShardingRules(
             fsdp_axis_size=mesh.shape[AxisNames.FSDP])
         self.num_replicas = batch_axis_size(mesh)
+        self.last_cost_analysis: dict | None = None   # set by precompile()
         if (self.sync.replicas_to_aggregate is not None
                 and self.sync.replicas_to_aggregate != self.num_replicas):
             raise ValueError(
@@ -190,6 +191,34 @@ class SyncReplicas:
                                 donate_argnums=donate_args)
         self.multi_step = jax.jit(self._multi_step,
                                   donate_argnums=donate_args)
+
+    # ---- AOT compile / cost analysis ------------------------------------
+    def precompile(self, state: TrainState, batch, *,
+                   multi: bool = False) -> dict:
+        """AOT-compile the (multi_)step for these arguments' avals, swap the
+        dispatch path to the compiled executable, and return XLA's cost
+        analysis (flops / bytes accessed / ...) for it.
+
+        This is what makes ``--step_timing`` records meaningful: the
+        executable is fixed, its static cost is recorded once, and
+        subsequent per-dispatch wall times measure exactly that program
+        (WorkerCacheLogger parity, SURVEY.md §2.4/§5.1). No-op (returns {})
+        under ``debug_checks``: checkify wraps the step in host-side error
+        plumbing that is not a single executable."""
+        name = "multi_step" if multi else "step"
+        fn = getattr(self, name)
+        if not hasattr(fn, "lower"):        # checkify wrapper: no AOT path
+            return {}
+        compiled = fn.lower(state, batch).compile()
+        setattr(self, name, compiled)
+        raw = compiled.cost_analysis() or {}
+        if isinstance(raw, (list, tuple)):  # older jax: one dict per device
+            raw = raw[0] if raw else {}
+        self.last_cost_analysis = {
+            k: float(v) for k, v in raw.items()
+            if k in ("flops", "optimal_seconds", "transcendentals",
+                     "bytes accessed")}
+        return self.last_cost_analysis
 
     # ---- state / batch placement ---------------------------------------
     def init(self,

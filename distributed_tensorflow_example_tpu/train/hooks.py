@@ -209,6 +209,86 @@ class GlobalStepWaiterHook(Hook):
                      self.wait_until_step)
 
 
+class StepTimingHook(Hook):
+    """Per-dispatch device-time records — the WorkerCacheLogger analogue
+    (SURVEY.md §2.2 WorkerCacheLogger row, §5.1).
+
+    The reference logged per-step RecvTensor start/end usecs into a
+    timeline; under SPMD the per-step observable is the compiled step's
+    device latency. The Trainer measures each dispatch (perf_counter
+    around the step call + block_until_ready, so eval/checkpoint/hook
+    time between steps is NOT attributed — see ``last_dispatch_ms``) and
+    this hook aggregates: every ``every_steps`` *trained steps* worth of
+    dispatches it writes a percentile summary to the metrics JSONL —
+    plus, once, the compiled executable's static cost analysis
+    (flops / bytes accessed) captured by :meth:`SyncReplicas.precompile`.
+    Blocking defeats the async dispatch queue (documented overhead) —
+    opt-in via ``--step_timing``.
+    """
+
+    def __init__(self, metrics_logger: MetricsLogger | None,
+                 every_steps: int = 100):
+        self.every_steps = every_steps
+        self.metrics_logger = metrics_logger
+        self._times_ms: list[float] = []
+        self._first_ms: float | None = None   # includes compile time
+        self._cost_logged = False
+        self.last_record: dict | None = None
+
+    def after_step(self, trainer, step, metrics):
+        dt_ms = getattr(trainer, "last_dispatch_ms", None)
+        if dt_ms is None:
+            return
+        if self._first_ms is None:
+            self._first_ms = dt_ms       # first dispatch (may include a
+            return                       # compile); kept out of the stats
+        self._times_ms.append(dt_ms)
+        spd = max(1, getattr(trainer.config, "steps_per_loop", 1))
+        # cadence in dispatches, not raw step numbers: with K steps per
+        # dispatch, step only hits multiples of lcm(K, every_steps)
+        if len(self._times_ms) >= max(1, self.every_steps // spd):
+            self._emit(trainer, step, spd)
+
+    def _emit(self, trainer, step: int, steps_per_dispatch: int) -> None:
+        if not self._times_ms:
+            return
+        arr = np.asarray(self._times_ms)
+        rec: dict[str, Any] = {"step": step, "step_timing_ms": {
+            "n": int(arr.size),
+            "steps_per_dispatch": steps_per_dispatch,
+            "mean": float(arr.mean()),
+            "p50": float(np.percentile(arr, 50)),
+            "p90": float(np.percentile(arr, 90)),
+            "p99": float(np.percentile(arr, 99)),
+            "max": float(arr.max()),
+            "first_dispatch_ms": float(self._first_ms),
+        }}
+        if not self._cost_logged:
+            cost = getattr(trainer.sync, "last_cost_analysis", None)
+            if cost:
+                rec["step_cost_analysis"] = cost
+                self._cost_logged = True
+        self.last_record = rec
+        self._times_ms.clear()
+        if _is_chief():
+            log.info("step %d: dispatch p50=%.3fms p99=%.3fms (n=%d)",
+                     step, rec["step_timing_ms"]["p50"],
+                     rec["step_timing_ms"]["p99"], arr.size)
+            if self.metrics_logger:
+                self.metrics_logger.log(rec)
+
+    def end(self, trainer):
+        # flush the residue so --step_timing always yields >= 1 record
+        # (short runs, or steps_per_loop not dividing every_steps)
+        step = int(jax.device_get(trainer.state.step))
+        self._emit(trainer, step,
+                   max(1, getattr(trainer.config, "steps_per_loop", 1)))
+
+    def wants_metrics(self, step):
+        # consumes trainer-measured dispatch times, not metric values
+        return False
+
+
 class ProfilerHook(Hook):
     """Capture a jax.profiler trace for steps in [start, stop)
     (ProfilerHook/timeline parity, SURVEY.md §5.1)."""

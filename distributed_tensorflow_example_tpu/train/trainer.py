@@ -128,6 +128,9 @@ class Trainer:
                                             cfg.obs.summary_every_steps))
         if cfg.obs.check_nans:
             hs.append(hooks_lib.NanHook())
+        if cfg.obs.step_timing:
+            hs.append(hooks_lib.StepTimingHook(self.metrics_logger,
+                                               cfg.obs.log_every_steps))
         if self.ckpt_manager and (cfg.checkpoint.save_steps
                                   or cfg.checkpoint.save_secs):
             hs.append(hooks_lib.CheckpointSaverHook(
@@ -179,6 +182,15 @@ class Trainer:
         t_start = time.perf_counter()
 
         spl = max(1, self.config.steps_per_loop)
+        # --step_timing: AOT-compile the dispatch path on the first batch so
+        # the cost analysis (flops/bytes) is recorded and per-dispatch times
+        # measure a fixed executable; the dispatch itself is timed HERE —
+        # perf_counter around the step call + block — so eval/checkpoint/
+        # hook time between steps never pollutes the samples (StepTimingHook
+        # aggregates trainer.last_dispatch_ms)
+        timing = self.config.obs.step_timing
+        want_aot = timing
+        self.last_dispatch_ms: float | None = None
         try:
             while not stop:
                 remaining = self.config.train_steps - step
@@ -189,12 +201,23 @@ class Trainer:
                     stacked = {k: np.stack([b[k] for b in stack])
                                for k in stack[0]}
                     batch = self.sync.shard_stacked_batch(stacked)
+                    if want_aot:
+                        self.sync.precompile(state, batch, multi=True)
+                        want_aot = False
+                    t0 = time.perf_counter() if timing else 0.0
                     state, device_metrics = self.sync.multi_step(state, batch)
                     step += spl
                 else:
                     batch = self.sync.shard_batch(next(loader))
+                    if want_aot:
+                        self.sync.precompile(state, batch)
+                        want_aot = False
+                    t0 = time.perf_counter() if timing else 0.0
                     state, device_metrics = self.sync.step(state, batch)
                     step += 1
+                if timing:
+                    jax.block_until_ready(state.params)
+                    self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
                 self.state = state
 
                 wants = any(h.wants_metrics(step) for h in self.hooks)
@@ -269,7 +292,13 @@ class Trainer:
     def evaluate(self, state: TrainState,
                  batch_size: int | None = None) -> dict[str, float]:
         """Forward-only metrics over the eval set (the reference's final
-        test-accuracy pass, SURVEY.md §2.1 'Train loop + eval')."""
+        test-accuracy pass, SURVEY.md §2.1 'Train loop + eval').
+
+        Static-shape discipline: the tail batch is padded up to ``bs`` with
+        repeated rows and excluded via a ``__valid__`` example mask that
+        every model's ``eval_metrics`` honors — so the whole pass runs ONE
+        compiled executable regardless of eval-set size (no per-tail-shape
+        recompile; ``self._eval_fn._cache_size() == 1``)."""
         if self._eval_fn is None:
             self._eval_fn = jax.jit(self.model.eval_metrics)
         bs = batch_size or self.config.data.batch_size
@@ -280,16 +309,17 @@ class Trainer:
         for i in range(0, n, bs):
             batch = {k: v[i:i + bs] for k, v in self.eval_arrays.items()}
             m = len(next(iter(batch.values())))
-            if m == bs:
-                placed = self.sync.shard_batch(batch)
-            else:
-                # tail batch: may not divide the batch axes — run it
-                # replicated (one recompile; correctness over parallelism
-                # so the full eval set is covered, unlike dropping it)
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                rep = NamedSharding(self.mesh, P())
-                placed = jax.tree_util.tree_map(
-                    lambda x: jax.device_put(x, rep), batch)
+            if m < bs:
+                # pad with copies of row 0 (content is irrelevant — the
+                # mask zeroes its contribution); keeps the batch shape and
+                # therefore the sharding/executable static
+                batch = {k: np.concatenate(
+                    [v, np.repeat(v[:1], bs - m, axis=0)])
+                    for k, v in batch.items()}
+            mask = np.zeros((bs,), np.float32)
+            mask[:m] = 1.0
+            batch["__valid__"] = mask
+            placed = self.sync.shard_batch(batch)
             out = jax.device_get(
                 self._eval_fn(state.params, state.extras, placed))
             for k, v in out.items():

@@ -1,0 +1,147 @@
+"""Static-shape eval tail + per-step timing records (VERDICT r2 #6, #8).
+
+- evaluate() pads the tail batch to the training batch size and threads a
+  ``__valid__`` mask into eval_metrics, so the whole eval pass runs ONE
+  compiled executable and the padded rows contribute nothing.
+- ``--step_timing`` (ObservabilityConfig.step_timing) records per-dispatch
+  wall-time percentiles plus the compiled step's flops/bytes cost analysis
+  to the metrics JSONL — the WorkerCacheLogger analogue (SURVEY.md §2.4,
+  §5.1: the reference logged per-step RecvTensor start/end usecs).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                       MeshShape,
+                                                       ObservabilityConfig,
+                                                       OptimizerConfig,
+                                                       TrainConfig)
+from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+
+def _trainer(data, n_eval, *, obs=None, steps=4, spl=1):
+    cfg = TrainConfig(
+        model="mlp", train_steps=steps, mesh=MeshShape(data=4),
+        steps_per_loop=spl,
+        data=DataConfig(batch_size=64, seed=3),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        obs=obs or ObservabilityConfig(),
+        seed=7)
+    model = get_model("mlp", cfg)
+    return Trainer(model, cfg,
+                   {"x": data["train_x"], "y": data["train_y"]},
+                   eval_arrays={"x": data["test_x"][:n_eval],
+                                "y": data["test_y"][:n_eval]},
+                   mesh=local_mesh(4), process_index=0, num_processes=1)
+
+
+def _numpy_eval(state, model, xs, ys):
+    """Oracle: whole-set metrics in one unpadded forward pass."""
+    logits, _ = model.apply(state.params, state.extras,
+                            {"x": jnp.asarray(xs), "y": jnp.asarray(ys)})
+    logits = np.asarray(logits, np.float32)
+    logz = logits - logits.max(-1, keepdims=True)
+    logz = logz - np.log(np.exp(logz).sum(-1, keepdims=True))
+    loss = -logz[np.arange(len(ys)), ys].mean()
+    acc = (logits.argmax(-1) == ys).mean()
+    return {"loss": loss, "accuracy": acc}
+
+
+def test_eval_tail_is_masked_not_dropped_and_single_executable():
+    # 150 eval examples @ bs=64 -> batches of 64, 64, and a 22-row tail
+    data = synthetic_mnist(num_train=640, num_test=160, seed=0)
+    t = _trainer(data, n_eval=150)
+    state, _ = t.train()
+
+    got = t.evaluate(state)
+    want = _numpy_eval(state, t.model,
+                       data["test_x"][:150], data["test_y"][:150])
+    assert abs(got["loss"] - want["loss"]) < 1e-4
+    assert abs(got["accuracy"] - want["accuracy"]) < 1e-6
+
+    # static-shape discipline: full batches and the padded tail share ONE
+    # compiled executable (the old path recompiled per tail shape)
+    assert t._eval_fn._cache_size() == 1
+    t.close()
+
+
+def test_eval_exact_multiple_unchanged():
+    data = synthetic_mnist(num_train=640, num_test=128, seed=0)
+    t = _trainer(data, n_eval=128)
+    state, _ = t.train()
+    got = t.evaluate(state)
+    want = _numpy_eval(state, t.model,
+                       data["test_x"][:128], data["test_y"][:128])
+    assert abs(got["loss"] - want["loss"]) < 1e-4
+    assert abs(got["accuracy"] - want["accuracy"]) < 1e-6
+    assert t._eval_fn._cache_size() == 1
+    t.close()
+
+
+def test_bert_eval_tail_masked():
+    """The mask composes with BERT's per-token MLM weights."""
+    from distributed_tensorflow_example_tpu.models.bert import (Bert,
+                                                                BertConfig)
+    cfg = BertConfig.tiny()
+    cfg.dropout = 0.0
+    model = Bert(cfg)
+    params = model.init(jax.random.key(0))
+    batch = model.dummy_batch(8)
+
+    ref = {k: float(v) for k, v in
+           model.eval_metrics(params, {}, batch).items()}
+
+    # pad 8 -> 12 with garbage rows; mask must make them invisible
+    padded = {k: np.concatenate([v, v[:4][::-1]]) for k, v in batch.items()}
+    padded["__valid__"] = np.array([1.0] * 8 + [0.0] * 4, np.float32)
+    got = {k: float(v) for k, v in
+           model.eval_metrics(params, {}, padded).items()}
+    assert abs(got["loss"] - ref["loss"]) < 1e-5
+    assert abs(got["mlm_accuracy"] - ref["mlm_accuracy"]) < 1e-6
+
+
+def test_step_timing_records(tmp_path):
+    metrics_path = str(tmp_path / "metrics.jsonl")
+    data = synthetic_mnist(num_train=640, num_test=64, seed=0)
+    obs = ObservabilityConfig(log_every_steps=4, metrics_path=metrics_path,
+                              step_timing=True)
+    t = _trainer(data, n_eval=64, obs=obs, steps=8)
+    t.train()
+    t.close()
+
+    recs = [json.loads(l) for l in open(metrics_path)]
+    timing = [r for r in recs if "step_timing_ms" in r]
+    assert timing, f"no step_timing_ms records in {recs}"
+    st = timing[0]["step_timing_ms"]
+    for key in ("n", "mean", "p50", "p90", "p99", "max",
+                "first_dispatch_ms"):
+        assert key in st, key
+    assert st["n"] >= 1 and st["p99"] >= st["p50"] > 0.0
+
+    # the compiled step's static cost analysis is recorded exactly once
+    costs = [r for r in recs if "step_cost_analysis" in r]
+    assert len(costs) == 1
+    assert costs[0]["step_cost_analysis"].get("flops", 0) > 0
+
+
+def test_step_timing_with_steps_per_loop(tmp_path):
+    """Timing records work for the K-steps-per-dispatch loop too."""
+    metrics_path = str(tmp_path / "metrics.jsonl")
+    data = synthetic_mnist(num_train=640, num_test=64, seed=0)
+    obs = ObservabilityConfig(log_every_steps=4, metrics_path=metrics_path,
+                              step_timing=True)
+    t = _trainer(data, n_eval=64, obs=obs, steps=16, spl=4)
+    t.train()
+    t.close()
+
+    recs = [json.loads(l) for l in open(metrics_path)]
+    timing = [r for r in recs if "step_timing_ms" in r]
+    assert timing
+    assert timing[0]["step_timing_ms"]["steps_per_dispatch"] == 4
