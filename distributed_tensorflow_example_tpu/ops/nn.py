@@ -162,11 +162,18 @@ def batchnorm(params: Params, extras: Params, x: jax.Array, *,
     *local* per-replica batch instead (running stats are pmean'd after the
     step, but the forward normalization differs from auto mode) — BN models
     are excluded from the auto==shard_map equivalence claim; see
-    ``parallel.sync_replicas``. Returns (y, new_extras)."""
+    ``parallel.sync_replicas``. Returns (y, new_extras).
+
+    Mixed precision: statistics and running stats are always f32 (they
+    accumulate), but the normalization is applied in ``x.dtype`` via a
+    folded per-channel scale/offset — bf16 activations stay bf16 end to
+    end, halving the HBM bytes of the BN/relu/residual chain (the ResNet
+    bottleneck on TPU is bandwidth, not MXU flops)."""
     if train:
         axes = tuple(range(x.ndim - 1))
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
         new_extras = {
             "mean": momentum * extras["mean"] + (1 - momentum) * mean,
             "var": momentum * extras["var"] + (1 - momentum) * var,
@@ -174,8 +181,11 @@ def batchnorm(params: Params, extras: Params, x: jax.Array, *,
     else:
         mean, var = extras["mean"], extras["var"]
         new_extras = extras
-    y = (x - mean) * lax.rsqrt(var + eps)
-    return y * params["scale"] + params["bias"], new_extras
+    # fold (mean, var, scale, bias) into y = x*a + b in f32, then apply in
+    # the activation dtype
+    inv = lax.rsqrt(var + eps) * params["scale"].astype(jnp.float32)
+    off = params["bias"].astype(jnp.float32) - mean * inv
+    return x * inv.astype(x.dtype) + off.astype(x.dtype), new_extras
 
 
 # ---------------------------------------------------------------------------
