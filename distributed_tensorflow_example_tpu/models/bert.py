@@ -153,7 +153,10 @@ class Bert:
              + nn.embedding(params["embed"]["pos"],
                             jnp.arange(s, dtype=jnp.int32))[None]
              + nn.embedding(params["embed"]["type"], types))
-        h = nn.layernorm(params["embed_ln"], h.astype(jnp.float32))
+        # residual stream rides in the compute dtype from here on (bf16 on
+        # TPU — half the HBM bytes per layer); layernorm keeps its
+        # statistics in f32 internally
+        h = nn.layernorm(params["embed_ln"], h).astype(self.dtype)
         # dropout requires randomness: rng=None (forward-only callers)
         # deterministically disables it rather than crashing in fold_in
         use_dropout = train and c.dropout > 0 and rng is not None
@@ -164,21 +167,19 @@ class Bert:
         for i in range(c.layers):
             lp = params[f"layer_{i}"]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            a = self._attend(lp["attn"], h.astype(self.dtype), mask,
-                             lrng, train)
+            a = self._attend(lp["attn"], h, mask, lrng, train)
             if use_dropout:
                 a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
                                train=True)
-            h = nn.layernorm(lp["attn_ln"],
-                             (h + a.astype(jnp.float32)))
-            f = nn.dense(lp["ffn"]["in"], h.astype(self.dtype),
-                         dtype=self.dtype)
+            h = nn.layernorm(lp["attn_ln"], h + a.astype(h.dtype))
+            f = nn.dense(lp["ffn"]["in"], h, dtype=self.dtype)
+            # gelu's f32 upcast fuses into the dot epilogue: no HBM cost
             f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
             f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
             if use_dropout:
                 f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
                                train=True)
-            h = nn.layernorm(lp["ffn_ln"], (h + f.astype(jnp.float32)))
+            h = nn.layernorm(lp["ffn_ln"], h + f.astype(h.dtype))
         return h
 
     def mlm_logits(self, params, seq_out, masked_positions):

@@ -53,7 +53,7 @@ class LeNet(DefaultRulesMixin):
         if train and self.dropout_rate > 0 and rng is not None:
             h = nn.dropout(rng, h, self.dropout_rate, train=True)
         logits = nn.dense(params["fc2"], h, dtype=self.dtype)
-        return logits, extras
+        return logits.astype(jnp.float32), extras
 
     def loss(self, params, extras, batch, rng):
         logits, new_extras = self.apply(params, extras, batch, rng, train=True)

@@ -39,8 +39,10 @@ class MLP(DefaultRulesMixin):
     def apply(self, params, extras, batch, rng=None, train: bool = False):
         x = batch["x"].reshape((batch["x"].shape[0], -1))
         h = jax.nn.relu(nn.dense(params["fc1"], x, dtype=self.dtype))
+        # logits in f32: softmax losses need the headroom (dense outputs
+        # the compute dtype since the bf16-activation change)
         logits = nn.dense(params["fc2"], h, dtype=self.dtype)
-        return logits, extras
+        return logits.astype(jnp.float32), extras
 
     def loss(self, params, extras, batch, rng):
         logits, new_extras = self.apply(params, extras, batch, rng, train=True)

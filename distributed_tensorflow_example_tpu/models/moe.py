@@ -91,7 +91,9 @@ class MoeBert(Bert):
              + nn.embedding(params["embed"]["pos"],
                             jnp.arange(s, dtype=jnp.int32))[None]
              + nn.embedding(params["embed"]["type"], types))
-        h = nn.layernorm(params["embed_ln"], h.astype(jnp.float32))
+        # bf16 residual stream, f32 layernorm statistics — same mixed-
+        # precision recipe as Bert.encode (see models/bert.py)
+        h = nn.layernorm(params["embed_ln"], h).astype(self.dtype)
         use_dropout = train and c.dropout > 0 and rng is not None
         if use_dropout:
             h = nn.dropout(jax.random.fold_in(rng, 1000), h, c.dropout,
@@ -101,27 +103,25 @@ class MoeBert(Bert):
         for i in range(c.layers):
             lp = params[f"layer_{i}"]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            a = self._attend(lp["attn"], h.astype(self.dtype), mask,
-                             lrng, train)
+            a = self._attend(lp["attn"], h, mask, lrng, train)
             if use_dropout:
                 a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
                                train=True)
-            h = nn.layernorm(lp["attn_ln"], (h + a.astype(jnp.float32)))
+            h = nn.layernorm(lp["attn_ln"], h + a.astype(h.dtype))
             if self._is_moe_layer(i):
-                f, aux = moe.moe_ffn(lp["moe"], h.astype(self.dtype),
+                f, aux = moe.moe_ffn(lp["moe"], h,
                                      n_experts=c.n_experts, top_k=c.top_k,
                                      capacity_factor=c.capacity_factor,
                                      dtype=self.dtype)
                 aux_total = aux_total + aux
             else:
-                f = nn.dense(lp["ffn"]["in"], h.astype(self.dtype),
-                             dtype=self.dtype)
+                f = nn.dense(lp["ffn"]["in"], h, dtype=self.dtype)
                 f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
                 f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
             if use_dropout:
                 f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
                                train=True)
-            h = nn.layernorm(lp["ffn_ln"], (h + f.astype(jnp.float32)))
+            h = nn.layernorm(lp["ffn_ln"], h + f.astype(h.dtype))
         return h, aux_total
 
     # ------------------------------------------------------------------

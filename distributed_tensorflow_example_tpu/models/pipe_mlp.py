@@ -48,7 +48,10 @@ def _block_scan(stacked, x, dtype):
         y = jax.lax.dot_general(
             h.astype(dtype), blk["kernel"].astype(dtype),
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return h + jax.nn.relu(y + blk["bias"].astype(jnp.float32)), None
+        # cast back to the carry dtype: with dtype=bf16 the f32-accumulated
+        # dot would otherwise promote the residual and break the scan carry
+        r = jax.nn.relu(y + blk["bias"].astype(jnp.float32)).astype(h.dtype)
+        return h + r, None
     out, _ = jax.lax.scan(body, x, stacked)
     return out
 
@@ -103,7 +106,7 @@ class PipeMlp:
         else:
             h = _block_scan(params["blocks"], h, self.dtype)
         logits = nn.dense(params["out_proj"], h, dtype=self.dtype)
-        return logits, extras
+        return logits.astype(jnp.float32), extras
 
     def loss(self, params, extras, batch, rng):
         logits, new_extras = self.apply(params, extras, batch, rng,

@@ -190,7 +190,7 @@ class ResNet(DefaultRulesMixin):
 
         h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))   # global avg pool
         logits = nn.dense(params["fc"], h, dtype=self.dtype)
-        return logits, (new if train else extras)
+        return logits.astype(jnp.float32), (new if train else extras)
 
     # ------------------------------------------------------------------
     def loss(self, params, extras, batch, rng):

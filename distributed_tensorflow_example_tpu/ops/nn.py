@@ -65,7 +65,12 @@ def dense_init(rng, in_dim: int, out_dim: int, *,
 
 def dense(params: Params, x: jax.Array, *, dtype=None) -> jax.Array:
     """y = x @ W + b. With ``dtype=bfloat16`` the matmul runs on the MXU in
-    bf16 with f32 accumulation (preferred_element_type)."""
+    bf16 with f32 accumulation (preferred_element_type), and the OUTPUT is
+    rounded back to bf16 in the dot's epilogue — the f32 accumulator never
+    reaches HBM, so downstream activations move at half the bytes (the
+    pre-round-3 f32 outputs made every transformer layer HBM-bound).
+    Callers that need f32 results (final logits feeding a softmax loss)
+    cast up afterwards."""
     kernel, bias = params["kernel"], params["bias"]
     if dtype is not None:
         x = x.astype(dtype)
@@ -73,6 +78,8 @@ def dense(params: Params, x: jax.Array, *, dtype=None) -> jax.Array:
     y = jax.lax.dot_general(x, kernel,
                             (((x.ndim - 1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
+    if dtype is not None:
+        y = y.astype(dtype)
     return y + bias.astype(y.dtype)
 
 
@@ -100,8 +107,9 @@ def conv2d(params: Params, x: jax.Array, *, stride: int = 1,
         kernel = kernel.astype(dtype)
     # no preferred_element_type here: the conv VJP transposes with the f32
     # cotangent against bf16 operands and lax.conv rejects mixed dtypes
-    # (dot_general's VJP handles it, so dense() does use f32 accumulation);
-    # downstream BN recasts activations to f32 immediately
+    # (dot_general's VJP handles it, so dense() accumulates in f32); conv
+    # outputs stay bf16 and batchnorm normalizes in that dtype (its
+    # statistics are taken in f32 internally)
     y = lax.conv_general_dilated(
         x, kernel, window_strides=(stride, stride), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
@@ -135,10 +143,16 @@ def layernorm_init(dim: int, *, param_dtype=jnp.float32) -> Params:
 
 
 def layernorm(params: Params, x: jax.Array, *, eps: float = 1e-6) -> jax.Array:
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
-    y = (x - mu) * lax.rsqrt(var + eps)
-    return y * params["scale"] + params["bias"]
+    """Per-token statistics in f32 (upcast fuses into the reduction — no
+    f32 HBM round-trip), output in ``x.dtype`` so a bf16 residual stream
+    stays bf16."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    y = (y * params["scale"].astype(jnp.float32)
+         + params["bias"].astype(jnp.float32))
+    return y.astype(x.dtype)
 
 
 def batchnorm_init(dim: int, *, param_dtype=jnp.float32

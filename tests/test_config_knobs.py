@@ -71,3 +71,26 @@ def test_total_num_replicas_match_ok():
     SyncReplicas(m.loss, tx, mesh,
                  sync=SyncConfig(total_num_replicas=2,
                                  replicas_to_aggregate=2))
+
+
+@pytest.mark.parametrize("name", ["mlp", "pipe_mlp", "lenet", "resnet20",
+                                  "resnet50", "bert_tiny", "moe_bert_tiny"])
+def test_compute_dtype_bf16_traces_and_logits_f32(name):
+    """dtype=bfloat16 must trace end to end (regression: the bf16 dense
+    output once broke pipe_mlp's scan-carry dtype) and classification /
+    MLM logits must come out f32 for softmax-loss headroom."""
+    cfg = TrainConfig(model=name, dtype="bfloat16")
+    m = get_model(name, cfg)
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    batch = m.dummy_batch(8)
+
+    logits_shape = jax.eval_shape(
+        lambda p, e, b: m.apply(p, e, b, train=False)[0],
+        params, extras, batch)
+    assert logits_shape.dtype == jnp.float32, logits_shape.dtype
+
+    loss_shape = jax.eval_shape(
+        lambda p, e, b: m.loss(p, e, b, jax.random.key(1))[0],
+        params, extras, batch)
+    assert loss_shape.dtype == jnp.float32
