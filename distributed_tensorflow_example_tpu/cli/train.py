@@ -41,6 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--native", action="store_true",
                    help="use the C++ native loader when built (falls back "
                         "to the Python loader if unavailable)")
+    p.add_argument("--streaming", action="store_true",
+                   help="decode-per-batch streaming input pipeline "
+                        "(bounded memory; ImageNet-scale folder trees)")
     p.add_argument("--max_per_class", type=int, default=None,
                    help="cap eagerly-decoded images per class (ImageNet "
                         "folder loading; full train split is ~770GB as f32)")
@@ -143,7 +146,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                         data_dir=args.data_dir,
                         batch_size=args.batch_size, seed=args.seed,
                         native=args.native, seq_len=args.seq_len,
-                        max_per_class=args.max_per_class),
+                        max_per_class=args.max_per_class,
+                        streaming=args.streaming),
         optimizer=OptimizerConfig(name=args.optimizer,
                                   learning_rate=args.learning_rate,
                                   momentum=args.momentum,
@@ -189,6 +193,20 @@ def load_dataset(cfg: TrainConfig, model=None):
         from ..data.cifar import get_cifar10
         d = get_cifar10(cfg.data.data_dir, cfg.data.synthetic)
     elif name in ("resnet50", "imagenet"):
+        if cfg.data.streaming and not cfg.data.synthetic:
+            if not cfg.data.data_dir:
+                raise SystemExit("--streaming requires --data_dir")
+            # train split streams (decode-per-batch, bounded memory); the
+            # eval split stays an eager array dict — UNCAPPED, same as the
+            # eager path: eval numbers must be comparable regardless of
+            # the train cap (see data/imagenet.py get_imagenet)
+            from ..data.imagenet import load_imagenet_folder
+            from ..data.streaming import StreamingSource
+            train_src = StreamingSource(
+                cfg.data.data_dir, "train",
+                max_per_class=cfg.data.max_per_class)
+            v = load_imagenet_folder(cfg.data.data_dir, "val")
+            return train_src, {"x": v["val_x"], "y": v["val_y"]}
         from ..data.imagenet import get_imagenet
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)

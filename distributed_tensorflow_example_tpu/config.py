@@ -29,6 +29,9 @@ class DataConfig:
     native: bool = False            # C++ loader (data/native.py) when built;
                                     # falls back to Python when unavailable
     max_per_class: int | None = None  # cap eager folder-tree decode (ImageNet)
+    streaming: bool = False         # decode-per-batch thread-pool pipeline
+                                    # (data/streaming.py) instead of eager
+                                    # whole-split decode — ImageNet scale
     # BERT-only knobs
     seq_len: int = 128
     vocab_size: int = 30522

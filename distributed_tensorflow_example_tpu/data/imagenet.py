@@ -24,6 +24,43 @@ def _list_classes(split_dir: str) -> list[str]:
                   if os.path.isdir(os.path.join(split_dir, d)))
 
 
+def decode_image(path: str, image_size: int) -> np.ndarray:
+    """Decode + short-side resize + center crop -> [S,S,3] f32 in [0,1].
+    The one decode routine shared by the eager loader and the streaming
+    pipeline so both produce bit-identical pixels."""
+    from PIL import Image
+    img = Image.open(path).convert("RGB")
+    w, h = img.size
+    scale = image_size / min(w, h)
+    img = img.resize((round(w * scale), round(h * scale)))
+    w, h = img.size
+    left, top = (w - image_size) // 2, (h - image_size) // 2
+    img = img.crop((left, top, left + image_size, top + image_size))
+    return np.asarray(img, np.float32) / 255.0
+
+
+def index_image_folder(data_dir: str, split: str = "train", *,
+                       max_per_class: int | None = None
+                       ) -> tuple[list[str], np.ndarray]:
+    """(paths, labels) for a torchvision-layout folder tree — the cheap
+    metadata pass the streaming pipeline builds on (no pixel IO)."""
+    split_dir = os.path.join(data_dir, split)
+    classes = _list_classes(split_dir)
+    if not classes:
+        raise FileNotFoundError(f"no class dirs under {split_dir}")
+    paths: list[str] = []
+    labels: list[int] = []
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(split_dir, cls)
+        files = sorted(f for f in os.listdir(cdir)
+                       if f.lower().endswith(_EXTS))
+        if max_per_class:
+            files = files[:max_per_class]
+        paths.extend(os.path.join(cdir, f) for f in files)
+        labels.extend([label] * len(files))
+    return paths, np.asarray(labels, np.int32)
+
+
 def load_imagenet_folder(data_dir: str, split: str = "train", *,
                          image_size: int = 224,
                          max_per_class: int | None = None
@@ -35,29 +72,13 @@ def load_imagenet_folder(data_dir: str, split: str = "train", *,
     except ImportError as e:                      # pragma: no cover
         raise RuntimeError("PIL is required for real ImageNet decoding") from e
 
-    split_dir = os.path.join(data_dir, split)
-    classes = _list_classes(split_dir)
-    if not classes:
-        raise FileNotFoundError(f"no class dirs under {split_dir}")
-    xs, ys = [], []
-    for label, cls in enumerate(classes):
-        cdir = os.path.join(split_dir, cls)
-        files = sorted(f for f in os.listdir(cdir)
-                       if f.lower().endswith(_EXTS))
-        if max_per_class:
-            files = files[:max_per_class]
-        for f in files:
-            img = Image.open(os.path.join(cdir, f)).convert("RGB")
-            w, h = img.size
-            scale = image_size / min(w, h)
-            img = img.resize((round(w * scale), round(h * scale)))
-            w, h = img.size
-            left, top = (w - image_size) // 2, (h - image_size) // 2
-            img = img.crop((left, top, left + image_size, top + image_size))
-            xs.append(np.asarray(img, np.float32) / 255.0)
-            ys.append(label)
-    return {f"{split}_x": np.stack(xs),
-            f"{split}_y": np.asarray(ys, np.int32)}
+    # one file-selection pass shared with the streaming pipeline: the
+    # eager/streaming bit-identity guarantee rests on indexing + decoding
+    # through the same code
+    paths, labels = index_image_folder(data_dir, split,
+                                       max_per_class=max_per_class)
+    xs = [decode_image(p, image_size) for p in paths]
+    return {f"{split}_x": np.stack(xs), f"{split}_y": labels}
 
 
 def synthetic_imagenet(num_train: int = 512, num_test: int = 128,

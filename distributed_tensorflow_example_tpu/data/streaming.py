@@ -1,0 +1,151 @@
+"""Streaming image-folder pipeline: decode-per-batch on a thread pool.
+
+The eager path (``imagenet.load_imagenet_folder``) decodes the whole split
+up front — fine for fine-tune-scale, impossible for full ImageNet (150 GB
+of f32 pixels). This module is the framework's input pipeline for that
+scale, the role the reference filled with queue-runner threads feeding the
+graph (SURVEY.md §2.2 Coordinator/QueueRunner, §2.1 input pipeline):
+
+- a cheap metadata pass indexes ``(path, label)`` pairs;
+- each batch's images are decoded on a thread pool (PIL releases the GIL
+  in its decode/resize C paths) only when the batch is needed;
+- ``PrefetchIterator`` double-buffers so the host decodes batch k+1 while
+  the device trains on batch k;
+- memory is bounded by ``prefetch × batch`` decoded images instead of the
+  dataset size.
+
+Determinism contract — identical to ``ShardedLoader`` (loader.py): seeded
+per-epoch shuffle of the GLOBAL index, each process takes its contiguous
+slice, so the global batch sequence is independent of process count and
+bit-identical to the eager path over the same files (the shared
+``imagenet.decode_image`` guarantees identical pixels). Exact-resume
+fast-forward works through the same ``epoch``/``steps_per_epoch``
+interface.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator
+
+import numpy as np
+
+from .imagenet import decode_image, index_image_folder
+from .loader import Batch, PrefetchIterator
+
+
+class StreamingImageFolder:
+    """Lazily-decoded torchvision-layout image folder.
+
+    Presents the same iteration surface as ``ShardedLoader`` (epoch
+    attribute, ``steps_per_epoch``, endless ``__iter__``) so
+    ``make_loader``-style fast-forward and the Trainer work unchanged.
+    """
+
+    def __init__(self, data_dir: str, split: str = "train", *,
+                 image_size: int = 224,
+                 max_per_class: int | None = None,
+                 global_batch: int = 128,
+                 process_index: int = 0, num_processes: int = 1,
+                 shuffle: bool = True, seed: int = 0,
+                 decode_threads: int = 8):
+        if global_batch % num_processes:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{num_processes} processes")
+        self.paths, self.labels = index_image_folder(
+            data_dir, split, max_per_class=max_per_class)
+        self.n = len(self.paths)
+        if self.n < global_batch:
+            # fail fast: steps_per_epoch=0 would make __iter__ a silent
+            # busy-loop and skip() a ZeroDivisionError
+            raise ValueError(
+                f"split {split!r} has {self.n} images < global_batch "
+                f"{global_batch}")
+        self.image_size = image_size
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_processes
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self._pool = ThreadPoolExecutor(max_workers=max(1, decode_threads))
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.n // self.global_batch      # always drop_remainder
+
+    def _decode(self, indices: np.ndarray) -> Batch:
+        xs = list(self._pool.map(
+            lambda i: decode_image(self.paths[i], self.image_size), indices))
+        return {"x": np.stack(xs), "y": self.labels[indices]}
+
+    def epoch_batches(self, epoch: int | None = None,
+                      start: int = 0) -> Iterator[Batch]:
+        epoch = self.epoch if epoch is None else epoch
+        idx = np.arange(self.n)
+        if self.shuffle:
+            np.random.RandomState((self.seed, epoch)).shuffle(idx)
+        for b in range(start, self.steps_per_epoch):
+            g0 = b * self.global_batch
+            gidx = idx[g0:g0 + self.global_batch]
+            l0 = self.process_index * self.local_batch
+            yield self._decode(gidx[l0:l0 + self.local_batch])
+
+    def skip(self, start_step: int) -> None:
+        """Exact-resume fast-forward WITHOUT decoding the skipped batches
+        (the eager path's _fast_forward burns a next() per skipped batch;
+        here a skipped batch would cost real JPEG decodes)."""
+        self.epoch = start_step // self.steps_per_epoch
+        self._start_batch = start_step % self.steps_per_epoch
+
+    _start_batch = 0
+
+    def __iter__(self) -> Iterator[Batch]:
+        start, self._start_batch = self._start_batch, 0
+        while True:
+            yield from self.epoch_batches(self.epoch, start=start)
+            start = 0
+            self.epoch += 1
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class StreamingSource:
+    """Trainer-pluggable data source (duck-typed alternative to the
+    batch-keyed numpy dict): the Trainer calls :meth:`make_loader` with its
+    sharding coordinates instead of wrapping arrays in a ShardedLoader."""
+
+    def __init__(self, data_dir: str, split: str = "train", *,
+                 image_size: int = 224, max_per_class: int | None = None,
+                 prefetch: int = 2, decode_threads: int = 8):
+        self.data_dir = data_dir
+        self.split = split
+        self.image_size = image_size
+        self.max_per_class = max_per_class
+        self.prefetch = prefetch
+        self.decode_threads = decode_threads
+        self._folder: StreamingImageFolder | None = None
+
+    def make_loader(self, global_batch: int, *, start_step: int = 0,
+                    process_index: int = 0, num_processes: int = 1,
+                    shuffle: bool = True, seed: int = 0,
+                    prefetch: int | None = None, **_unused) -> Iterator[Batch]:
+        if self._folder is not None:      # re-entry: release the previous
+            self._folder.close()          # decode pool, don't leak it
+        self._folder = StreamingImageFolder(
+            self.data_dir, self.split, image_size=self.image_size,
+            max_per_class=self.max_per_class, global_batch=global_batch,
+            process_index=process_index, num_processes=num_processes,
+            shuffle=shuffle, seed=seed, decode_threads=self.decode_threads)
+        if start_step > 0:
+            self._folder.skip(start_step)
+        it = iter(self._folder)
+        depth = self.prefetch if prefetch is None else prefetch
+        return PrefetchIterator(it, depth) if depth > 0 else it
+
+    def close(self) -> None:
+        if self._folder is not None:
+            self._folder.close()

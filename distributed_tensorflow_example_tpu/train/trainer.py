@@ -157,6 +157,17 @@ class Trainer:
         return state
 
     def _loader(self) -> Iterator[dict[str, np.ndarray]]:
+        if hasattr(self.train_arrays, "make_loader"):
+            # streaming source (e.g. data.streaming.StreamingSource):
+            # batches are materialized on demand instead of held in RAM
+            return self.train_arrays.make_loader(
+                self.config.data.batch_size,
+                start_step=self.start_step,
+                process_index=self.process_index,
+                num_processes=self.num_processes,
+                shuffle=self.config.data.shuffle,
+                seed=self.config.data.seed,
+                prefetch=self.config.data.prefetch)
         return make_loader(
             self.train_arrays, self.config.data.batch_size,
             prefetch=self.config.data.prefetch,
@@ -281,6 +292,8 @@ class Trainer:
         if self.ckpt_manager is not None:
             self.ckpt_manager.close()
         self.metrics_logger.close()
+        if hasattr(self.train_arrays, "close"):
+            self.train_arrays.close()     # streaming source: decode pool
 
     def __enter__(self) -> "Trainer":
         return self
@@ -303,7 +316,9 @@ class Trainer:
             self._eval_fn = jax.jit(self.model.eval_metrics)
         bs = batch_size or self.config.data.batch_size
         n = len(next(iter(self.eval_arrays.values())))
-        bs = min(bs, n)
+        # bs stays the configured (mesh-divisible) batch even when the eval
+        # set is smaller: a single padded+masked batch keeps the sharding
+        # legal and the executable static
         totals: dict[str, float] = {}
         count = 0
         for i in range(0, n, bs):
