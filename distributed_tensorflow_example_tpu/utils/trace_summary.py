@@ -1,0 +1,186 @@
+"""Trace summary: aggregate device op time from a jax.profiler capture.
+
+The reference fed per-step RunMetadata into a chrome-trace timeline
+(SURVEY.md §5.1, `timeline.py`); the TPU-native capture is a
+``jax.profiler.start_trace`` xplane protobuf. This tool reduces that
+capture to the numbers a perf investigation actually starts from:
+
+- per-line busy time (interval union — async DMA lines overlap compute,
+  so naive event sums overcount several-fold);
+- critical-path ("XLA Ops" line) time bucketed by op family
+  (convolution/dot, fusion, async-copy, slice/dus, other);
+- the top-K ops by total time, with shapes straight from the HLO names.
+
+Usage::
+
+    python -m distributed_tensorflow_example_tpu.utils.trace_summary \
+        /tmp/trace_dir [--top 20] [--json]
+
+Parsing needs the xplane proto, vendored by the locally installed
+TensorFlow wheel (``tensorflow.tsl.profiler.protobuf``) — an OPTIONAL
+dependency: the framework never imports TF at runtime; this offline tool
+degrades with a clear error when TF is absent.
+
+The round-3 ResNet-50/BERT investigations in BASELINE.md ("ResNet-50
+roofline") were produced with exactly this aggregation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+from typing import Any
+
+
+def _load_xspaces(trace_dir: str) -> list[tuple[str, Any]]:
+    """Every capture file in the directory — a multi-host trace writes one
+    xplane.pb per host; summarizing a single arbitrary file would hide
+    cross-host imbalance. Returns [(filename, XSpace), ...]."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "trace_summary needs the xplane proto from the tensorflow "
+            "wheel (offline tool only; the framework itself does not "
+            "depend on TF)") from e
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    out = []
+    for p in paths:
+        xs = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xs.ParseFromString(f.read())
+        out.append((os.path.basename(p), xs))
+    return out
+
+
+def _union_ms(intervals: list[tuple[int, int]]) -> float:
+    """Total covered time of possibly-overlapping [start, end) ps spans."""
+    intervals.sort()
+    total = 0
+    cur_s = cur_e = None
+    for s, e in intervals:
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total / 1e9
+
+
+def _family(op_name: str) -> str:
+    """Bucket by the DEFINING op name only — the event name is the full
+    instruction text, so matching on the whole string would classify by
+    operand names (an op consuming %fusion.44 is not a fusion). Caveat:
+    XLA:TPU hides most convolutions and many dots inside fusion bodies, so
+    'fusion' time includes the MXU compute they contain — bound MXU time
+    with the flops roofline (cost_analysis flops / peak), not with this
+    breakdown."""
+    n = op_name.split(" = ")[0].lower()
+    if "copy-start" in n or "copy-done" in n:
+        return "async-copy"
+    if "convolution" in n or n.startswith("%dot"):
+        return "conv/dot"
+    if "fusion" in n:
+        return "fusion"
+    if "slice" in n or "dynamic" in n:
+        return "slice/dus"
+    if "all-reduce" in n or "all-gather" in n or "all-to-all" in n \
+            or "collective" in n or "permute" in n:
+        return "collective"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "copy/transpose"
+    return "other"
+
+
+def summarize(trace_dir: str, top: int = 20) -> dict[str, Any]:
+    """Returns {device: {lines: [...], ops_line: {...}}} for every
+    accelerator plane in the capture."""
+    spaces = _load_xspaces(trace_dir)
+    out: dict[str, Any] = {}
+    for fname, xs in spaces:
+        for plane in xs.planes:
+            if "TPU" not in plane.name and "GPU" not in plane.name \
+                    and "CPU" not in plane.name:
+                continue
+            key = (plane.name if len(spaces) == 1
+                   else f"{fname}:{plane.name}")
+            _summarize_plane(out, key, plane, top)
+    if not out:
+        raise RuntimeError("no device planes found in the capture")
+    return out
+
+
+def _summarize_plane(out: dict[str, Any], key: str, plane, top: int) -> None:
+    meta = {m.id: m.name for m in plane.event_metadata.values()}
+    lines = []
+    ops_line: dict[str, Any] | None = None
+    for line in plane.lines:
+        spans = []
+        fam_ms: collections.Counter = collections.Counter()
+        per_op: collections.Counter = collections.Counter()
+        per_op_n: collections.Counter = collections.Counter()
+        for ev in line.events:
+            spans.append((ev.offset_ps, ev.offset_ps + ev.duration_ps))
+            name = meta.get(ev.metadata_id, "?")
+            fam_ms[_family(name)] += ev.duration_ps / 1e9
+            per_op[name] += ev.duration_ps / 1e9
+            per_op_n[name] += 1
+        if not spans:
+            continue
+        rec = {
+            "line": line.name,
+            "events": len(spans),
+            "busy_ms": round(_union_ms(spans), 3),
+            "families_ms": {k: round(v, 3)
+                            for k, v in fam_ms.most_common()},
+        }
+        lines.append(rec)
+        if line.name == "XLA Ops":
+            ops_line = dict(rec, top_ops=[
+                {"ms": round(ms, 3), "count": per_op_n[name],
+                 "op": name[:160]}
+                for name, ms in per_op.most_common(top)])
+    if lines:
+        out[key] = {"lines": lines, "ops": ops_line}
+
+
+def format_text(summary: dict[str, Any]) -> str:
+    parts = []
+    for dev, rec in summary.items():
+        parts.append(f"== {dev}")
+        for ln in rec["lines"]:
+            fams = " ".join(f"{k}={v}ms" for k, v in
+                            ln["families_ms"].items())
+            parts.append(f"  line {ln['line']!r}: busy={ln['busy_ms']}ms "
+                         f"events={ln['events']}  {fams}")
+        ops = rec.get("ops")
+        if ops:
+            parts.append("  -- top ops (critical path):")
+            for o in ops["top_ops"]:
+                parts.append(f"    {o['ms']:9.3f} ms x{o['count']:<5d} "
+                             f"{o['op']}")
+    return "\n".join(parts)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    s = summarize(args.trace_dir, top=args.top)
+    print(json.dumps(s, indent=1) if args.json else format_text(s))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,44 @@
+"""Trace-summary tool: capture a real (CPU) jax.profiler trace and reduce
+it. The xplane proto comes from the installed TF wheel — an optional,
+offline-only dependency; skip cleanly when absent."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+
+from distributed_tensorflow_example_tpu.utils.trace_summary import (  # noqa: E402
+    _union_ms, format_text, summarize)
+
+
+def test_union_ms_merges_overlaps():
+    assert _union_ms([(0, 1_000_000_000), (500_000_000, 2_000_000_000),
+                      (3_000_000_000, 4_000_000_000)]) == pytest.approx(3.0)
+    assert _union_ms([]) == 0.0
+
+
+def test_summarize_real_capture(tmp_path):
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x @ x.T).sum()
+
+    x = jnp.asarray(np.random.RandomState(0).rand(256, 256), jnp.float32)
+    f(x).block_until_ready()          # compile outside the capture
+    jax.profiler.start_trace(str(tmp_path))
+    for _ in range(3):
+        f(x).block_until_ready()
+    jax.profiler.stop_trace()
+
+    s = summarize(str(tmp_path), top=5)
+    assert s, "no device planes parsed"
+    dev, rec = next(iter(s.items()))
+    assert rec["lines"] and all(l["busy_ms"] >= 0 for l in rec["lines"])
+    text = format_text(s)
+    assert "busy=" in text and dev in text
+
+
+def test_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        summarize(str(tmp_path / "nope"))
