@@ -209,6 +209,65 @@ class GlobalStepWaiterHook(Hook):
                      self.wait_until_step)
 
 
+class PreemptionHook(Hook):
+    """Graceful shutdown on SIGTERM/SIGINT: finish the in-flight step,
+    request a clean loop stop, and let ``CheckpointSaverHook.end()`` write
+    the final checkpoint — the Supervisor's stop→save semantics
+    (SURVEY.md §3.4/§3.5) applied to the TPU world, where the signal is
+    typically a VM maintenance-event notice.
+
+    Scope: per-process. On a single process this turns a SIGTERM into
+    "checkpoint at the step boundary and exit 0". Multi-host runs must be
+    stopped by the orchestrator on every host (a one-host stop would leave
+    the others blocked in a collective); there the recovery story is the
+    restore-or-init path on restart, not this hook — so the Trainer only
+    installs it when ``jax.process_count() == 1``.
+    """
+
+    def __init__(self, signals: tuple[int, ...] | None = None):
+        import signal as _signal
+        self.signals = signals or (_signal.SIGTERM, _signal.SIGINT)
+        self.stop_requested = False
+        self._prev: dict[int, Any] = {}
+
+    def begin(self, trainer):
+        import signal as _signal
+
+        def handler(signum, frame):
+            if self.stop_requested:
+                # second signal: the boundary never came (hung loader or
+                # device wait) — restore the previous disposition and
+                # re-raise so the user can actually stop the process
+                _signal.signal(signum,
+                               self._prev.get(signum, _signal.SIG_DFL))
+                log.warning("second signal %d: restoring default "
+                            "handling", signum)
+                _signal.raise_signal(signum)
+                return
+            log.warning("signal %d: stopping at the next step boundary "
+                        "(checkpoint will be written); send again to "
+                        "force", signum)
+            self.stop_requested = True
+
+        try:
+            for s in self.signals:
+                self._prev[s] = _signal.signal(s, handler)
+        except ValueError:
+            # not the main thread (e.g. Trainer driven from a test
+            # harness thread): signals can't be installed — undo any
+            # partial install and stay inert
+            self.end(trainer)
+
+    def after_step(self, trainer, step, metrics):
+        return self.stop_requested or None
+
+    def end(self, trainer):
+        import signal as _signal
+        for s, prev in self._prev.items():
+            _signal.signal(s, prev)
+        self._prev.clear()
+
+
 class StepTimingHook(Hook):
     """Per-dispatch device-time records — the WorkerCacheLogger analogue
     (SURVEY.md §2.2 WorkerCacheLogger row, §5.1).

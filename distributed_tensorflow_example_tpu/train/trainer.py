@@ -136,6 +136,10 @@ class Trainer:
             hs.append(hooks_lib.CheckpointSaverHook(
                 self.ckpt_manager, save_steps=cfg.checkpoint.save_steps,
                 save_secs=cfg.checkpoint.save_secs))
+            if self.num_processes == 1:
+                # SIGTERM → save-and-exit; multi-host stop is the
+                # orchestrator's job (see PreemptionHook docstring)
+                hs.append(hooks_lib.PreemptionHook())
         if cfg.obs.profile_steps and cfg.obs.profile_dir:
             hs.append(hooks_lib.ProfilerHook(cfg.obs.profile_dir,
                                              *cfg.obs.profile_steps))
@@ -183,10 +187,6 @@ class Trainer:
         if self.state is None:
             self.initialize()
         state = self.state
-        for h in self.hooks:
-            h.begin(self)
-
-        loader = self._loader()
         step = self.start_step
         stop = step >= self.config.train_steps
         device_metrics: dict | None = None
@@ -203,6 +203,13 @@ class Trainer:
         want_aot = timing
         self.last_dispatch_ms: float | None = None
         try:
+            # begin() inside the try: a failing begin (or anything after a
+            # partial begin) must still run every hook's end() — hooks
+            # with process-global effects (PreemptionHook's signal
+            # handlers) would otherwise leak past train()
+            for h in self.hooks:
+                h.begin(self)
+            loader = self._loader()
             while not stop:
                 remaining = self.config.train_steps - step
                 if spl > 1 and remaining >= spl:

@@ -55,7 +55,10 @@ def _load_xspaces(trace_dir: str) -> list[tuple[str, Any]]:
         xs = xplane_pb2.XSpace()
         with open(p, "rb") as f:
             xs.ParseFromString(f.read())
-        out.append((os.path.basename(p), xs))
+        # key by the path relative to trace_dir: two captures in one dir
+        # share basenames (<host>.xplane.pb under timestamped subdirs)
+        # and must not overwrite each other in the summary
+        out.append((os.path.relpath(p, trace_dir), xs))
     return out
 
 

@@ -1,0 +1,75 @@
+"""PreemptionHook: SIGTERM → finish the step, checkpoint, exit cleanly
+(the Supervisor stop→save semantics; TPU maintenance-event handling)."""
+
+import os
+import signal
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+    CheckpointManager)
+from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                       DataConfig,
+                                                       MeshShape,
+                                                       OptimizerConfig,
+                                                       TrainConfig)
+from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+from distributed_tensorflow_example_tpu.train import hooks as hooks_lib
+from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+
+class _SigtermAt(hooks_lib.Hook):
+    def __init__(self, at_step: int):
+        self.at_step = at_step
+
+    def after_step(self, trainer, step, metrics):
+        if step == self.at_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _trainer(ckpt_dir, steps=50, extra=None):
+    cfg = TrainConfig(
+        model="mlp", train_steps=steps, mesh=MeshShape(data=4),
+        data=DataConfig(batch_size=64, seed=3),
+        optimizer=OptimizerConfig(name="sgd", learning_rate=0.1),
+        checkpoint=CheckpointConfig(directory=ckpt_dir, save_steps=100),
+        seed=7)
+    data = synthetic_mnist(num_train=640, num_test=64, seed=0)
+    model = get_model("mlp", cfg)
+    return Trainer(model, cfg, {"x": data["train_x"], "y": data["train_y"]},
+                   mesh=local_mesh(4), process_index=0, num_processes=1,
+                   hooks=extra or [])
+
+
+def test_sigterm_checkpoints_and_stops(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    t = _trainer(ckpt, steps=50, extra=[_SigtermAt(3)])
+    state, summary = t.train()
+    t.close()
+
+    # stopped at the boundary after the signal, far short of train_steps
+    stopped_at = summary["final_step"]
+    assert 3 <= stopped_at <= 4, stopped_at
+    # the stop checkpoint exists and restores to the same step
+    mgr = CheckpointManager(ckpt)
+    assert mgr.latest_step() == stopped_at
+    # handlers restored after end()
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+    # resume runs to completion untouched by the old signal
+    t2 = _trainer(ckpt, steps=stopped_at + 5)
+    s2, summary2 = t2.train()
+    t2.close()
+    assert summary2["final_step"] == stopped_at + 5
+    assert int(jax.device_get(s2.step)) == stopped_at + 5
+
+
+def test_no_signal_trains_to_completion(tmp_path):
+    t = _trainer(str(tmp_path / "ckpt"), steps=6)
+    _, summary = t.train()
+    t.close()
+    assert summary["final_step"] == 6
+    assert np.isfinite(summary["final_metrics"]["loss"])
