@@ -95,6 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="scalar-summary cadence to the metrics JSONL "
                         "(SummarySaverHook parity; 0 disables)")
     p.add_argument("--metrics_path", default=None)
+    p.add_argument("--tb_logdir", default=None,
+                   help="write TensorBoard scalar event files here "
+                        "(tf.summary FileWriter parity; no TF dependency)")
     p.add_argument("--eval_every_steps", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--check_nans", action="store_true",
@@ -168,6 +171,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             log_every_steps=args.log_every_steps,
             summary_every_steps=args.summary_every_steps,
             metrics_path=args.metrics_path,
+            tb_logdir=args.tb_logdir,
             check_nans=args.check_nans,
             debug_checks=args.debug_checks,
             debug_nans=args.debug_nans,

@@ -116,6 +116,8 @@ class ObservabilityConfig:
 
     log_every_steps: int = 100
     metrics_path: str | None = None   # JSONL sink; None => stdout only
+    tb_logdir: str | None = None      # TensorBoard event-file sink
+                                      # (utils/tb_events.py, SURVEY §5.5)
     profile_steps: tuple[int, int] | None = None  # (start, stop) step range
     profile_dir: str | None = None
     check_nans: bool = False          # NanTensorHook analogue

@@ -149,9 +149,14 @@ class CheckpointSaverHook(Hook):
             self._last_save_t = time.time()
 
     def end(self, trainer):
-        # deterministic across processes: depends only on step history
+        # deterministic across processes: depends only on step history.
+        # No-progress guard: if this train() call never advanced the step
+        # (e.g. startup failed before the first dispatch), there is nothing
+        # new to capture — and saving WOULD be harmful: a fresh-init
+        # ckpt-0 written by a failed launch hijacks the next run's
+        # restore-or-init
         step = int(jax.device_get(trainer.state.step))
-        if self._last_saved_step != step:
+        if step != trainer.start_step and self._last_saved_step != step:
             self.manager.save(trainer.state, step)
             self._last_saved_step = step
         self.manager.wait()        # async writes must land before exit
@@ -232,6 +237,8 @@ class PreemptionHook(Hook):
 
     def begin(self, trainer):
         import signal as _signal
+        self.stop_requested = False   # a prior run's stop must not leak
+                                      # into a resumed train() call
 
         def handler(signum, frame):
             if self.stop_requested:

@@ -83,7 +83,8 @@ class Trainer:
                                   config.checkpoint.keep_checkpoint_every_n_hours),
                               async_save=config.checkpoint.async_save)
             if config.checkpoint.directory else None)
-        self.metrics_logger = MetricsLogger(config.obs.metrics_path)
+        self.metrics_logger = MetricsLogger(config.obs.metrics_path,
+                                            tb_logdir=config.obs.tb_logdir)
 
         self.process_index = (jax.process_index() if process_index is None
                               else process_index)

@@ -9,6 +9,7 @@ examples/sec/chip computation per the driver metric (BASELINE.json:2).
 from __future__ import annotations
 
 import json
+import numbers
 import os
 import time
 from typing import Any, TextIO
@@ -18,21 +19,56 @@ import jax
 
 class MetricsLogger:
     """Append-only JSONL metrics writer; process 0 writes, like the chief's
-    summary thread (supervisor.py:675-679 parity)."""
+    summary thread (supervisor.py:675-679 parity). With ``tb_logdir`` the
+    same records also stream to a TensorBoard event file
+    (utils/tb_events.py — the tf.summary FileWriter role, SURVEY.md §5.5):
+    every numeric field of a record that carries a ``step`` becomes a
+    scalar, one-level-nested dicts flatten to ``outer/inner`` tags."""
 
-    def __init__(self, path: str | None = None, *, also_stdout: bool = False):
+    def __init__(self, path: str | None = None, *, also_stdout: bool = False,
+                 tb_logdir: str | None = None):
         self.path = path
         self.also_stdout = also_stdout
         self._f: TextIO | None = None
-        if path and jax.process_index() == 0:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            self._f = open(path, "a", buffering=1)
+        self._tb = None
+        if jax.process_index() == 0:
+            if path:
+                os.makedirs(os.path.dirname(os.path.abspath(path)),
+                            exist_ok=True)
+                self._f = open(path, "a", buffering=1)
+            if tb_logdir:
+                from .tb_events import EventFileWriter
+                self._tb = EventFileWriter(tb_logdir)
+
+    @staticmethod
+    def _flatten_scalars(record: dict[str, Any]) -> dict[str, float]:
+        def num(v):
+            # numbers.Number covers numpy scalars too — the JSONL sink
+            # accepts them via default=float, so the TB sink must as well
+            return isinstance(v, numbers.Number)
+
+        out: dict[str, float] = {}
+        for k, v in record.items():
+            if k in ("step", "time"):
+                continue
+            if isinstance(v, dict):
+                for k2, v2 in v.items():
+                    if num(v2):
+                        out[f"{k}/{k2}"] = float(v2)
+            elif num(v):
+                out[k] = float(v)
+        return out
 
     def log(self, record: dict[str, Any]) -> None:
         record = dict(record, time=time.time())
         line = json.dumps(record, default=float)
         if self._f is not None:
             self._f.write(line + "\n")
+        if self._tb is not None and "step" in record:
+            scalars = self._flatten_scalars(record)
+            if scalars:
+                self._tb.scalars(int(record["step"]), scalars,
+                                 wall_time=record["time"])
         if self.also_stdout and jax.process_index() == 0:
             print(line, flush=True)
 
@@ -40,6 +76,9 @@ class MetricsLogger:
         if self._f is not None:
             self._f.close()
             self._f = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
 
 class RateTracker:

@@ -1,0 +1,147 @@
+"""TensorBoard event-file writer — dependency-free tf.summary parity.
+
+The reference's Supervisor ran a summary thread writing scalar summaries
+into ``events.out.tfevents.*`` files that TensorBoard tails (SURVEY.md
+§5.5: tf.summary FileWriter, supervisor.py:675-679). The framework's
+primary metrics sink is the JSONL stream (utils/metrics.py), but event
+files are the ecosystem's lingua franca, so this module writes them
+natively — NO tensorflow/tensorboard import, just the two stable wire
+formats involved:
+
+- TFRecord framing: ``<len u64><masked crc32c(len) u32><payload>
+  <masked crc32c(payload) u32>`` (little-endian);
+- the ``Event``/``Summary`` protobuf messages, hand-encoded (protobuf
+  wire format is stable and the three fields used here — wall_time=1,
+  step=2, summary=5 with value{tag=1, simple_value=2} — are fixed).
+
+Verified round-trip against TensorFlow's own ``summary_iterator`` in
+``tests/test_tb_events.py`` (TF used only as a test oracle).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+# ---------------------------------------------------------------------------
+# crc32c (Castagnoli), table-driven — required by TFRecord framing
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = []
+_POLY = 0x82F63B78
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf encoding (wire types 0=varint, 1=fixed64, 2=bytes,
+# 5=fixed32)
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _key(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _double(field: int, v: float) -> bytes:
+    return _key(field, 1) + struct.pack("<d", v)
+
+
+def _float(field: int, v: float) -> bytes:
+    return _key(field, 5) + struct.pack("<f", v)
+
+
+def _int64(field: int, v: int) -> bytes:
+    return _key(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _bytes(field: int, v: bytes) -> bytes:
+    return _key(field, 2) + _varint(len(v)) + v
+
+
+def _scalar_event(step: int, tag: str, value: float,
+                  wall_time: float) -> bytes:
+    # Summary.Value{ tag=1:string, simple_value=2:float }
+    sval = _bytes(1, tag.encode()) + _float(2, float(value))
+    summary = _bytes(1, sval)                    # Summary{ value=1 repeated }
+    # Event{ wall_time=1:double, step=2:int64, summary=5:message }
+    return _double(1, wall_time) + _int64(2, step) + _bytes(5, summary)
+
+
+def _file_version_event(wall_time: float) -> bytes:
+    # Event{ wall_time=1, file_version=3:string } — TB expects "brain.Event:2"
+    return _double(1, wall_time) + _bytes(3, b"brain.Event:2")
+
+
+class EventFileWriter:
+    """Append scalar summaries to an ``events.out.tfevents.*`` file.
+
+    Usage::
+
+        w = EventFileWriter(logdir)
+        w.scalars(step, {"loss": 0.3, "accuracy": 0.9})
+        w.close()
+    """
+
+    def __init__(self, logdir: str, *, filename_suffix: str = ""):
+        os.makedirs(logdir, exist_ok=True)
+        name = (f"events.out.tfevents.{int(time.time())}."
+                f"{socket.gethostname()}.{os.getpid()}{filename_suffix}")
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "ab")
+        self._record(_file_version_event(time.time()))
+        self._f.flush()
+
+    def _record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def scalar(self, step: int, tag: str, value: float,
+               wall_time: float | None = None) -> None:
+        self._record(_scalar_event(step, tag, value,
+                                   time.time() if wall_time is None
+                                   else wall_time))
+
+    def scalars(self, step: int, values: dict[str, float],
+                wall_time: float | None = None) -> None:
+        wt = time.time() if wall_time is None else wall_time
+        for tag, v in values.items():
+            self.scalar(step, tag, v, wt)
+        self._f.flush()
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()

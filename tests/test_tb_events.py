@@ -1,0 +1,60 @@
+"""TensorBoard event writer: dependency-free wire format, verified against
+TensorFlow's own reader as an oracle (TF is a test-only dependency)."""
+
+import glob
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.utils.metrics import MetricsLogger
+from distributed_tensorflow_example_tpu.utils.tb_events import (
+    EventFileWriter, _crc32c, _masked_crc)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(bytes(32)) == 0x8A9136AA
+    assert _masked_crc(b"123456789") != _crc32c(b"123456789")
+
+
+def test_roundtrip_against_tensorflow_reader(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    w = EventFileWriter(str(tmp_path))
+    w.scalars(5, {"loss": 0.25, "accuracy": 0.875}, wall_time=123.5)
+    w.scalar(6, "loss", 0.125, wall_time=124.0)
+    w.close()
+
+    events = list(tf.compat.v1.train.summary_iterator(w.path))
+    # first record is the file_version header
+    assert events[0].file_version == "brain.Event:2"
+    scalars = [(e.step, v.tag, v.simple_value, e.wall_time)
+               for e in events[1:] for v in e.summary.value]
+    assert (5, "loss", 0.25, 123.5) in scalars
+    assert (5, "accuracy", 0.875, 123.5) in scalars
+    assert (6, "loss", 0.125, 124.0) in scalars
+    assert len(scalars) == 3
+
+
+def test_metrics_logger_tb_sink(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    ml = MetricsLogger(str(tmp_path / "m.jsonl"),
+                       tb_logdir=str(tmp_path / "tb"))
+    ml.log({"step": 10, "loss": 1.5, "accuracy": 0.5,
+            "eval": {"loss": 2.0}, "note": "not-a-number"})
+    ml.log({"no_step_key": 1.0})          # no step -> JSONL only
+    ml.close()
+
+    paths = glob.glob(str(tmp_path / "tb" / "events.out.tfevents.*"))
+    assert len(paths) == 1
+    scalars = [(e.step, v.tag, round(v.simple_value, 6))
+               for e in tf.compat.v1.train.summary_iterator(paths[0])
+               for v in e.summary.value]
+    assert (10, "loss", 1.5) in scalars
+    assert (10, "accuracy", 0.5) in scalars
+    assert (10, "eval/loss", 2.0) in scalars     # one-level flatten
+    assert all(tag != "note" for _, tag, _ in scalars)
+    assert len(scalars) == 3
