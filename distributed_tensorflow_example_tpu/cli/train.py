@@ -80,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", default="xla", choices=["xla", "flash"],
                    help="attention implementation for transformer models "
                         "(flash = Pallas kernel, wins at long sequences)")
+    p.add_argument("--remat", default="none",
+                   choices=["none", "full", "dots"],
+                   help="jax.checkpoint each transformer layer: backward "
+                        "recomputes activations instead of keeping them in "
+                        "HBM ('full' saves only layer boundaries, 'dots' "
+                        "also keeps matmul outputs); long-context enabler")
     p.add_argument("--ckpt_dir", default=None)
     p.add_argument("--save_steps", type=int, default=0)
     p.add_argument("--save_secs", type=float, default=0.0)
@@ -144,6 +150,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         dtype=args.dtype,
         param_dtype=args.param_dtype,
         attention_impl=args.attention,
+        remat=args.remat,
         mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
         data=DataConfig(dataset=args.dataset or args.model,
                         data_dir=args.data_dir,

@@ -152,6 +152,9 @@ class TrainConfig:
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     param_dtype: str = "float32"
     attention_impl: str = "xla"      # xla | flash (pallas kernel; long-seq)
+    remat: str = "none"              # none | full | dots — jax.checkpoint
+                                     # each transformer layer (HBM for
+                                     # recompute; long-context enabler)
 
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -17,11 +17,20 @@ TPU-first design:
   "large embedding all-reduce" ride ICI.
 - sequence parallelism: pass ``attention_fn=make_ring_attention(mesh)``
   to shard attention over the ``seq`` axis (parallel/ring_attention.py).
+- rematerialisation: ``remat="full"|"dots"`` wraps each encoder layer in
+  ``jax.checkpoint`` so the backward pass recomputes activations instead
+  of holding them in HBM — the standard TPU trade of MXU flops (cheap)
+  for HBM bytes (scarce), and the knob that makes long-context training
+  fit (pairs with ``attention_impl="flash"``). "full" saves only layer
+  boundaries; "dots" additionally saves matmul outputs
+  (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``) —
+  less memory saved, less recompute.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable
 
 import jax
@@ -60,21 +69,43 @@ class BertConfig:
                    intermediate=256, max_len=128, max_predictions=8)
 
 
+#: remat knob -> jax.checkpoint policy. None policy = save nothing
+#: (maximum memory saving, full recompute); "dots" keeps matmul outputs
+#: resident so only the cheap elementwise chains re-run.
+REMAT_POLICIES: dict[str, Any] = {
+    "full": None,
+    "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
 class Bert:
     name = "bert"
 
     def __init__(self, cfg: BertConfig, dtype=jnp.float32,
                  attention_impl: str = "xla",
                  attention_fn: Callable | None = None,
-                 param_dtype=jnp.float32):
+                 param_dtype=jnp.float32, remat: str = "none"):
         assert cfg.hidden % cfg.heads == 0
+        if remat != "none" and remat not in REMAT_POLICIES:
+            raise ValueError(f"remat must be one of "
+                             f"{['none', *REMAT_POLICIES]}, got {remat!r}")
         self.cfg = cfg
         self.dtype = dtype
         self.param_dtype = param_dtype
         self.attention_impl = attention_impl
         # override hook: e.g. make_ring_attention(mesh) for seq parallelism
         self.attention_fn = attention_fn
+        self.remat = remat
         self.head_dim = cfg.hidden // cfg.heads
+
+    def _maybe_remat(self, layer_fn: Callable) -> Callable:
+        """Wrap a per-layer function ``(lp, h, mask, lrng) -> ...`` in
+        jax.checkpoint per ``self.remat``. Static knobs (train flags, layer
+        index) must already be bound via functools.partial/closure so every
+        remaining argument is a pytree of arrays (or None)."""
+        if self.remat == "none":
+            return layer_fn
+        return jax.checkpoint(layer_fn, policy=REMAT_POLICIES[self.remat])
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array):
@@ -140,11 +171,11 @@ class Bert:
         ctx = ctx.reshape(b, s, c.hidden)
         return nn.dense(p["o"], ctx, dtype=self.dtype)
 
-    def encode(self, params, batch, rng=None, train: bool = False):
-        """[B,S] ids -> [B,S,hidden] sequence output."""
+    def _embed(self, params, batch, rng, train):
+        """Shared embedding front-end -> (h, mask, use_dropout)."""
         c = self.cfg
         ids = batch["input_ids"]
-        b, s = ids.shape
+        _, s = ids.shape
         types = batch.get("token_type_ids",
                           jnp.zeros_like(ids))
         mask = batch.get("attention_mask", jnp.ones_like(ids))
@@ -163,23 +194,48 @@ class Bert:
         if use_dropout:
             h = nn.dropout(jax.random.fold_in(rng, 1000), h, c.dropout,
                            train=True)
+        return h, mask, use_dropout
 
+    def _attn_block(self, lp, h, mask, lrng, *, train: bool,
+                    use_dropout: bool):
+        """MHA -> dropout -> add&LN: the attention half every encoder
+        layer shares (MoeBert swaps only the FFN half)."""
+        a = self._attend(lp["attn"], h, mask, lrng, train)
+        if use_dropout:
+            a = nn.dropout(jax.random.fold_in(lrng, 1), a, self.cfg.dropout,
+                           train=True)
+        return nn.layernorm(lp["attn_ln"], h + a.astype(h.dtype))
+
+    def _ffn_block(self, lp, h, f, lrng, *, use_dropout: bool):
+        """dropout -> add&LN tail applied to an FFN output ``f``."""
+        if use_dropout:
+            f = nn.dropout(jax.random.fold_in(lrng, 2), f, self.cfg.dropout,
+                           train=True)
+        return nn.layernorm(lp["ffn_ln"], h + f.astype(h.dtype))
+
+    def _layer(self, lp, h, mask, lrng, *, train: bool,
+               use_dropout: bool):
+        """One encoder layer: MHA -> add&LN -> FFN(gelu) -> add&LN.
+        Pure in (lp, h, mask, lrng) so it can be jax.checkpoint-wrapped
+        (``_maybe_remat``); train/use_dropout are trace-time statics."""
+        h = self._attn_block(lp, h, mask, lrng, train=train,
+                             use_dropout=use_dropout)
+        f = nn.dense(lp["ffn"]["in"], h, dtype=self.dtype)
+        # gelu's f32 upcast fuses into the dot epilogue: no HBM cost
+        f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+        f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
+        return self._ffn_block(lp, h, f, lrng, use_dropout=use_dropout)
+
+    def encode(self, params, batch, rng=None, train: bool = False):
+        """[B,S] ids -> [B,S,hidden] sequence output."""
+        c = self.cfg
+        h, mask, use_dropout = self._embed(params, batch, rng, train)
+        layer = self._maybe_remat(
+            functools.partial(self._layer, train=train,
+                              use_dropout=use_dropout))
         for i in range(c.layers):
-            lp = params[f"layer_{i}"]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            a = self._attend(lp["attn"], h, mask, lrng, train)
-            if use_dropout:
-                a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
-                               train=True)
-            h = nn.layernorm(lp["attn_ln"], h + a.astype(h.dtype))
-            f = nn.dense(lp["ffn"]["in"], h, dtype=self.dtype)
-            # gelu's f32 upcast fuses into the dot epilogue: no HBM cost
-            f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
-            f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
-            if use_dropout:
-                f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
-                               train=True)
-            h = nn.layernorm(lp["ffn_ln"], h + f.astype(h.dtype))
+            h = layer(params[f"layer_{i}"], h, mask, lrng)
         return h
 
     def mlm_logits(self, params, seq_out, masked_positions):
@@ -272,11 +328,13 @@ def _make_bert(config: TrainConfig) -> Bert:
     cfg.vocab_size = config.data.vocab_size
     return Bert(cfg, dtype=resolve_dtype(config.dtype),
                 attention_impl=config.attention_impl,
-                param_dtype=resolve_dtype(config.param_dtype))
+                param_dtype=resolve_dtype(config.param_dtype),
+                remat=config.remat)
 
 
 @register_model("bert_tiny")
 def _make_bert_tiny(config: TrainConfig) -> Bert:
     return Bert(BertConfig.tiny(), dtype=resolve_dtype(config.dtype),
                 attention_impl=config.attention_impl,
-                param_dtype=resolve_dtype(config.param_dtype))
+                param_dtype=resolve_dtype(config.param_dtype),
+                remat=config.remat)

@@ -16,6 +16,7 @@ tests/test_moe.py).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -49,9 +50,10 @@ class MoeBert(Bert):
 
     def __init__(self, cfg: MoeBertConfig, dtype=jnp.float32,
                  attention_impl: str = "xla", attention_fn=None,
-                 param_dtype=jnp.float32):
+                 param_dtype=jnp.float32, remat: str = "none"):
         super().__init__(cfg, dtype=dtype, attention_impl=attention_impl,
-                         attention_fn=attention_fn, param_dtype=param_dtype)
+                         attention_fn=attention_fn, param_dtype=param_dtype,
+                         remat=remat)
         self.cfg: MoeBertConfig = cfg
 
     def _is_moe_layer(self, i: int) -> bool:
@@ -76,52 +78,45 @@ class MoeBert(Bert):
         h, _ = self.encode_with_aux(params, batch, rng, train)
         return h
 
+    def _moe_layer(self, lp, h, mask, lrng, *, train: bool,
+                   use_dropout: bool):
+        """One MoE encoder layer: MHA -> add&LN -> MoE FFN -> add&LN.
+        Returns ``(h, aux)`` — pure in its array args so it can be
+        jax.checkpoint-wrapped like Bert._layer. The attention half and
+        the FFN tail are shared with Bert (``_attn_block``/``_ffn_block``);
+        only the FFN body differs."""
+        c = self.cfg
+        h = self._attn_block(lp, h, mask, lrng, train=train,
+                             use_dropout=use_dropout)
+        f, aux = moe.moe_ffn(lp["moe"], h,
+                             n_experts=c.n_experts, top_k=c.top_k,
+                             capacity_factor=c.capacity_factor,
+                             dtype=self.dtype)
+        return self._ffn_block(lp, h, f, lrng, use_dropout=use_dropout), aux
+
     def encode_with_aux(self, params, batch, rng=None, train: bool = False):
         """Same block structure as Bert.encode with MoE FFNs swapped in;
         returns ``(seq_out, aux_total)`` — the summed per-layer router
         load-balancing losses ride the return path (never stored on
         ``self``: a tracer on a long-lived object leaks across traces)."""
         c = self.cfg
-        ids = batch["input_ids"]
-        b, s = ids.shape
-        types = batch.get("token_type_ids", jnp.zeros_like(ids))
-        mask = batch.get("attention_mask", jnp.ones_like(ids))
-
-        h = (nn.embedding(params["embed"]["word"], ids)
-             + nn.embedding(params["embed"]["pos"],
-                            jnp.arange(s, dtype=jnp.int32))[None]
-             + nn.embedding(params["embed"]["type"], types))
-        # bf16 residual stream, f32 layernorm statistics — same mixed-
-        # precision recipe as Bert.encode (see models/bert.py)
-        h = nn.layernorm(params["embed_ln"], h).astype(self.dtype)
-        use_dropout = train and c.dropout > 0 and rng is not None
-        if use_dropout:
-            h = nn.dropout(jax.random.fold_in(rng, 1000), h, c.dropout,
-                           train=True)
+        h, mask, use_dropout = self._embed(params, batch, rng, train)
+        dense_layer = self._maybe_remat(
+            functools.partial(self._layer, train=train,
+                              use_dropout=use_dropout))
+        moe_layer = self._maybe_remat(
+            functools.partial(self._moe_layer, train=train,
+                              use_dropout=use_dropout))
 
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(c.layers):
             lp = params[f"layer_{i}"]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            a = self._attend(lp["attn"], h, mask, lrng, train)
-            if use_dropout:
-                a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
-                               train=True)
-            h = nn.layernorm(lp["attn_ln"], h + a.astype(h.dtype))
             if self._is_moe_layer(i):
-                f, aux = moe.moe_ffn(lp["moe"], h,
-                                     n_experts=c.n_experts, top_k=c.top_k,
-                                     capacity_factor=c.capacity_factor,
-                                     dtype=self.dtype)
+                h, aux = moe_layer(lp, h, mask, lrng)
                 aux_total = aux_total + aux
             else:
-                f = nn.dense(lp["ffn"]["in"], h, dtype=self.dtype)
-                f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
-                f = nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
-            if use_dropout:
-                f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
-                               train=True)
-            h = nn.layernorm(lp["ffn_ln"], h + f.astype(h.dtype))
+                h = dense_layer(lp, h, mask, lrng)
         return h, aux_total
 
     # ------------------------------------------------------------------
@@ -161,11 +156,13 @@ def _make_moe_bert(config: TrainConfig) -> MoeBert:
     cfg.vocab_size = config.data.vocab_size
     return MoeBert(cfg, dtype=resolve_dtype(config.dtype),
                    attention_impl=config.attention_impl,
-                   param_dtype=resolve_dtype(config.param_dtype))
+                   param_dtype=resolve_dtype(config.param_dtype),
+                   remat=config.remat)
 
 
 @register_model("moe_bert_tiny")
 def _make_moe_bert_tiny(config: TrainConfig) -> MoeBert:
     return MoeBert(MoeBertConfig.tiny(), dtype=resolve_dtype(config.dtype),
                    attention_impl=config.attention_impl,
-                   param_dtype=resolve_dtype(config.param_dtype))
+                   param_dtype=resolve_dtype(config.param_dtype),
+                   remat=config.remat)
