@@ -19,6 +19,19 @@ keys are ``/``-joined pytree paths. PRNG-key leaves are serialized via
 represent ml_dtypes' bfloat16 — it round-trips as raw void) are stored as
 uint16 bit patterns under a ``__bf16__/`` key prefix and viewed back on
 load, so ``param_dtype=bfloat16`` states checkpoint losslessly.
+
+Sharded mode (``sharded=True`` — the TF Saver ``sharded=True`` analogue,
+and the path that scales past one host): instead of all-gathering every
+leaf to process 0, EACH process writes exactly the shard pieces it owns
+(the ``replica_id == 0`` addressable shards of every distributed array)
+to its own ``ckpt-N.shard-<p>-of-<P>.npz``; process 0 additionally writes
+a tiny ``ckpt-N.shards.json`` anchor and rotates the ring. Save traffic
+per host is O(params/P) instead of O(params), writes land in parallel,
+and no cross-host gather happens at all. Restore reads back selectively:
+a process reads only the pieces overlapping the shards it needs for the
+template's sharding (exact-match fast path), falling back to assembling
+a full leaf only when the piece layout and the target sharding disagree
+(e.g. restoring onto a different mesh).
 """
 
 from __future__ import annotations
@@ -71,6 +84,135 @@ def _flatten(state: PyTree) -> dict[str, np.ndarray]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# sharded-mode helpers
+# ---------------------------------------------------------------------------
+
+_SHARD_META_KEY = "__shardmeta__"      # reserved npz key: JSON piece index
+
+
+def _norm_index(index, shape) -> tuple[tuple[int, int], ...]:
+    """Normalize a shard index (tuple of slices) to ((start, stop), ...)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, _ = sl.indices(dim)
+        out.append((int(start), int(stop)))
+    return tuple(out)
+
+
+def _piece_key(leaf_key: str, start: tuple[int, ...]) -> str:
+    return leaf_key + "::" + "_".join(str(s) for s in start)
+
+
+def _flatten_local(state: PyTree) -> tuple[dict[str, np.ndarray], dict]:
+    """This process's owned pieces of the state pytree.
+
+    Ownership: a process owns the ``replica_id == 0`` addressable shards
+    of every distributed array (each distinct piece of data has exactly one
+    replica 0 globally, so every byte is written exactly once across the
+    job). Host-local leaves (python/numpy scalars, PRNG keys, and
+    fully-addressable arrays, which are identical on every process) belong
+    to process 0.
+
+    Returns ``(pieces, meta)`` where ``pieces`` maps npz keys to arrays
+    and ``meta`` records, per leaf: dtype, global shape, kind, and the
+    (start, shape) of each piece this process wrote.
+    """
+    is_proc0 = jax.process_index() == 0
+    pieces: dict[str, np.ndarray] = {}
+    meta: dict[str, dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = _path_str(path)
+        if _is_key(leaf):
+            if is_proc0:
+                arr = np.asarray(jax.random.key_data(leaf))
+                pk = _piece_key(key, (0,) * arr.ndim)
+                pieces[pk] = arr
+                meta[key] = {"kind": "prngkey", "dtype": str(arr.dtype),
+                             "shape": list(arr.shape),
+                             "pieces": [{"key": pk,
+                                         "start": [0] * arr.ndim,
+                                         "shape": list(arr.shape)}]}
+            continue
+        if isinstance(leaf, jax.Array):
+            if leaf.is_fully_addressable and not is_proc0:
+                # host-local arrays are identical on every process (same
+                # init, same step count); process 0's copy is canonical
+                continue
+            shape = leaf.shape
+            entry = {"kind": "array", "dtype": str(leaf.dtype),
+                     "shape": list(shape), "pieces": []}
+            seen: set = set()
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                bounds = _norm_index(shard.index, shape)
+                if bounds in seen:
+                    continue
+                seen.add(bounds)
+                arr = np.asarray(jax.device_get(shard.data))
+                if arr.dtype == ml_dtypes.bfloat16:
+                    arr = arr.view(np.uint16)
+                start = tuple(b[0] for b in bounds)
+                pk = _piece_key(key, start)
+                pieces[pk] = arr
+                entry["pieces"].append({"key": pk, "start": list(start),
+                                        "shape": list(arr.shape)})
+            if entry["pieces"]:
+                meta[key] = entry
+            continue
+        if is_proc0:
+            arr = np.asarray(jax.device_get(leaf))
+            stored = (arr.view(np.uint16)
+                      if arr.dtype == ml_dtypes.bfloat16 else arr)
+            start = (0,) * arr.ndim
+            pk = _piece_key(key, start)
+            pieces[pk] = stored
+            meta[key] = {"kind": "array", "dtype": str(arr.dtype),
+                         "shape": list(arr.shape),
+                         "pieces": [{"key": pk, "start": list(start),
+                                     "shape": list(arr.shape)}]}
+    return pieces, meta
+
+
+def _merge_metas(loads: dict[str, "np.lib.npyio.NpzFile"]) -> dict[str, dict]:
+    """Merge every open shard file's embedded piece index into one leaf
+    map; each piece entry gains a ``file`` field naming its shard file."""
+    merged: dict[str, dict] = {}
+    for p, z in loads.items():
+        meta = json.loads(bytes(z[_SHARD_META_KEY]).decode())
+        for leaf_key, entry in meta.items():
+            tgt = merged.setdefault(
+                leaf_key, {**entry, "pieces": []})
+            for piece in entry["pieces"]:
+                tgt["pieces"].append({**piece, "file": p})
+    return merged
+
+
+def _view_dtype(arr: np.ndarray, dtype: str) -> np.ndarray:
+    return arr.view(ml_dtypes.bfloat16) if dtype == "bfloat16" else arr
+
+
+def _leaf_from_pieces(entry: dict,
+                      loads: dict[str, "np.lib.npyio.NpzFile"]):
+    """Assemble a full leaf from its saved pieces."""
+    dtype = entry["dtype"]
+    shape = tuple(entry["shape"])
+    out = np.empty(shape, dtype=np.uint16 if dtype == "bfloat16"
+                   else np.dtype(dtype))
+    covered = 0
+    for piece in entry["pieces"]:
+        sl = tuple(slice(s, s + d) for s, d in
+                   zip(piece["start"], piece["shape"]))
+        out[sl] = loads[piece["file"]][piece["key"]]
+        covered += int(np.prod(piece["shape"])) if piece["shape"] else 1
+    if covered < int(np.prod(shape) if shape else 1):
+        raise ValueError(
+            f"sharded checkpoint does not cover leaf of shape {shape}: "
+            f"{covered} elements present — missing shard files?")
+    return out.view(ml_dtypes.bfloat16) if dtype == "bfloat16" else out
+
+
 def _unflatten(template: PyTree, arrays: dict[str, np.ndarray]) -> PyTree:
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
@@ -118,11 +260,21 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, *, max_to_keep: int = 5,
-                 keep_every_n_hours: float = 0.0, async_save: bool = False):
+                 keep_every_n_hours: float = 0.0, async_save: bool = False,
+                 sharded: bool = False):
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.keep_every_n_hours = keep_every_n_hours
         self.async_save = async_save
+        self.sharded = sharded
+        if sharded and async_save and jax.process_count() > 1:
+            # the sharded commit protocol barriers across hosts after the
+            # parallel writes; running that barrier on a background thread
+            # would interleave with the training loop's collectives
+            raise ValueError(
+                "sharded=True with async_save is only supported "
+                "single-process: the multi-host commit barrier cannot run "
+                "on the writer thread")
         self._lock = threading.Lock()
         # guards the _pending slot itself: save()/wait() can race from the
         # step-based and wall-clock saver threads (ADVICE r2); the write
@@ -165,12 +317,19 @@ class CheckpointManager:
     def checkpoint_path(self, step: int) -> str:
         return os.path.join(self.directory, f"{PREFIX}-{step}.npz")
 
+    def shard_anchor_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{PREFIX}-{step}.shards.json")
+
+    def _anchor_exists(self, step: int) -> bool:
+        return (os.path.exists(self.checkpoint_path(step))
+                or os.path.exists(self.shard_anchor_path(step)))
+
     def all_steps(self) -> list[int]:
         self.wait()                # async write may not have landed yet
         st = self._state()
         steps = []
         for p in st["all_model_checkpoint_paths"] + st.get("kept_forever", []):
-            m = re.search(rf"{PREFIX}-(\d+)\.npz$", p)
+            m = re.search(rf"{PREFIX}-(\d+)\.(npz|shards\.json)$", p)
             if m and os.path.exists(os.path.join(self.directory, p)):
                 steps.append(int(m.group(1)))
         return sorted(set(steps))
@@ -206,6 +365,8 @@ class CheckpointManager:
         """
         if step is None:
             step = int(jax.device_get(state.step))
+        if self.sharded:
+            return self._save_sharded(state, step)
         arrays = _flatten(state)
         if not self.is_writer:
             return None
@@ -222,53 +383,131 @@ class CheckpointManager:
             return self.checkpoint_path(step)
         return self._write(arrays, step)
 
+    def _atomic_npz(self, arrays: dict[str, np.ndarray], path: str) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        os.close(fd)
+        np.savez(tmp, **arrays)
+        # np.savez appends .npz to names lacking it
+        tmp_npz = tmp if tmp.endswith(".npz") else tmp + ".npz"
+        os.replace(tmp_npz, path)
+        if tmp != tmp_npz and os.path.exists(tmp):
+            os.remove(tmp)
+
+    def _remove_victim(self, victim: str) -> None:
+        """Delete a rotated-out checkpoint — all of it, for sharded ones."""
+        vp = os.path.join(self.directory, victim)
+        if victim.endswith(".shards.json"):
+            step = re.search(rf"{PREFIX}-(\d+)\.shards\.json$", victim)
+            if step:
+                import glob as _glob
+                for f in _glob.glob(os.path.join(
+                        self.directory,
+                        f"{PREFIX}-{step.group(1)}.shard-*.npz")):
+                    os.remove(f)
+        if os.path.exists(vp):
+            os.remove(vp)
+
+    def _commit(self, base: str) -> None:
+        """Record anchor ``base`` in the state file + rotate the ring."""
+        st = self._state()
+        now = time.time()
+        # a step may only live in ONE list: re-saving an existing step
+        # (end-of-run save after restore, or a ring entry promoted to
+        # kept-forever) must not leave a stale entry behind — ring
+        # rotation would os.remove a file the other list still names
+        if base in st["all_model_checkpoint_paths"]:
+            st["all_model_checkpoint_paths"].remove(base)
+        was_kept = base in st.get("kept_forever", [])
+        if was_kept:
+            st["kept_forever"].remove(base)
+        # a re-save of the same step in the OTHER format supersedes it:
+        # evict the old anchor (and its shard files) so a stale
+        # ckpt-N.npz can never shadow a newer ckpt-N.shards.json in
+        # restore(), which prefers the single-file format
+        m = re.search(rf"{PREFIX}-(\d+)\.(npz|shards\.json)$", base)
+        if m:
+            other = (f"{PREFIX}-{m.group(1)}."
+                     + ("shards.json" if m.group(2) == "npz" else "npz"))
+            if other in st["all_model_checkpoint_paths"]:
+                st["all_model_checkpoint_paths"].remove(other)
+            if other in st.get("kept_forever", []):
+                st["kept_forever"].remove(other)
+                was_kept = True       # kept-forever status follows the step
+            self._remove_victim(other)
+        if was_kept or (self.keep_every_n_hours > 0 and
+                        now - self._last_kept_forever
+                        >= self.keep_every_n_hours * 3600):
+            # once kept-forever, always kept-forever: a re-save must not
+            # demote the step into the ring where rotation deletes it
+            st.setdefault("kept_forever", []).append(base)
+            if not was_kept:
+                self._last_kept_forever = now
+        else:
+            st["all_model_checkpoint_paths"].append(base)
+        st["latest"] = base
+        # ring rotation (max_to_keep, saver.py:448 parity)
+        while len(st["all_model_checkpoint_paths"]) > self.max_to_keep:
+            self._remove_victim(st["all_model_checkpoint_paths"].pop(0))
+        self._write_state(st)
+
     def _write(self, arrays: dict[str, np.ndarray], step: int) -> str:
         with self._lock:
             path = self.checkpoint_path(step)
-            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
-            os.close(fd)
-            np.savez(tmp, **arrays)
-            # np.savez appends .npz to names lacking it
-            tmp_npz = tmp if tmp.endswith(".npz") else tmp + ".npz"
-            os.replace(tmp_npz, path)
-            if tmp != tmp_npz and os.path.exists(tmp):
-                os.remove(tmp)
-
-            st = self._state()
-            base = os.path.basename(path)
-            now = time.time()
-            # a step may only live in ONE list: re-saving an existing step
-            # (end-of-run save after restore, or a ring entry promoted to
-            # kept-forever) must not leave a stale entry behind — ring
-            # rotation would os.remove a file the other list still names
-            if base in st["all_model_checkpoint_paths"]:
-                st["all_model_checkpoint_paths"].remove(base)
-            was_kept = base in st.get("kept_forever", [])
-            if was_kept:
-                st["kept_forever"].remove(base)
-            if was_kept or (self.keep_every_n_hours > 0 and
-                            now - self._last_kept_forever
-                            >= self.keep_every_n_hours * 3600):
-                # once kept-forever, always kept-forever: a re-save must not
-                # demote the step into the ring where rotation deletes it
-                st.setdefault("kept_forever", []).append(base)
-                if not was_kept:
-                    self._last_kept_forever = now
-            else:
-                st["all_model_checkpoint_paths"].append(base)
-            st["latest"] = base
-            # ring rotation (max_to_keep, saver.py:448 parity)
-            while len(st["all_model_checkpoint_paths"]) > self.max_to_keep:
-                victim = st["all_model_checkpoint_paths"].pop(0)
-                vp = os.path.join(self.directory, victim)
-                if os.path.exists(vp):
-                    os.remove(vp)
-            self._write_state(st)
+            self._atomic_npz(arrays, path)
+            self._commit(os.path.basename(path))
             return path
+
+    def _save_sharded(self, state: PyTree, step: int) -> str | None:
+        """Every process writes its owned pieces in parallel; process 0
+        commits the anchor after a cross-host barrier (two-phase: shard
+        files first, then the tiny anchor — a torn save is invisible to
+        restore because only the committed anchor is ever consulted)."""
+        pieces, meta = _flatten_local(state)
+        p, nprocs = jax.process_index(), jax.process_count()
+        shard_path = os.path.join(
+            self.directory, f"{PREFIX}-{step}.shard-{p}-of-{nprocs}.npz")
+        os.makedirs(self.directory, exist_ok=True)
+        pieces[_SHARD_META_KEY] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)
+
+        def write_and_commit() -> str:
+            with self._lock:
+                self._atomic_npz(pieces, shard_path)
+                if nprocs > 1:
+                    from jax.experimental import multihost_utils
+                    multihost_utils.sync_global_devices(
+                        f"ckpt-shard-write-{step}")
+                if self.is_writer:
+                    anchor = self.shard_anchor_path(step)
+                    tmp = anchor + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump({"num_shards": nprocs, "step": step,
+                                   "files": [f"{PREFIX}-{step}.shard-"
+                                             f"{i}-of-{nprocs}.npz"
+                                             for i in range(nprocs)]}, f)
+                    os.replace(tmp, anchor)
+                    self._commit(os.path.basename(anchor))
+                if nprocs > 1:
+                    from jax.experimental import multihost_utils
+                    # non-writers must not read the state file before the
+                    # writer's commit lands
+                    multihost_utils.sync_global_devices(
+                        f"ckpt-shard-commit-{step}")
+                return shard_path
+
+        if self._executor is not None:      # single-process only (ctor)
+            with self._pending_lock:
+                if self._pending is not None:
+                    self._pending.result()
+                self._pending = self._executor.submit(write_and_commit)
+            return shard_path
+        return write_and_commit()
 
     def restore(self, template: PyTree, step: int | None = None) -> PyTree:
         """Load ``step`` (default: latest) into the template's structure &
-        shardings. Raises FileNotFoundError when nothing exists."""
+        shardings. Raises FileNotFoundError when nothing exists. The
+        on-disk format (single-file vs sharded) is auto-detected, so a
+        run may switch ``sharded`` modes across restarts."""
         self.wait()                # an in-flight async write may be `step`
         if step is None:
             step = self.latest_step()
@@ -276,18 +515,105 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no checkpoint under {self.directory!r}")
         path = self.checkpoint_path(step)
-        if not os.path.exists(path):
-            raise FileNotFoundError(path)
-        with np.load(path) as z:
-            arrays = {k: z[k] for k in z.files}
-        return _unflatten(template, arrays)
+        if os.path.exists(path):
+            with np.load(path) as z:
+                arrays = {k: z[k] for k in z.files}
+            return _unflatten(template, arrays)
+        if os.path.exists(self.shard_anchor_path(step)):
+            return self._restore_sharded(template, step)
+        raise FileNotFoundError(path)
+
+    def _restore_sharded(self, template: PyTree, step: int) -> PyTree:
+        with open(self.shard_anchor_path(step)) as f:
+            anchor = json.load(f)
+        paths = [os.path.join(self.directory, b) for b in anchor["files"]]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            raise FileNotFoundError(
+                f"sharded checkpoint step {step} is missing shard files "
+                f"{[os.path.basename(m) for m in missing]} — all shards "
+                "must live on a filesystem every host can read")
+        loads = {p: np.load(p) for p in paths}
+        metas = _merge_metas(loads)
+        try:
+            paths_and_leaves, treedef = \
+                jax.tree_util.tree_flatten_with_path(template)
+            leaves = []
+            for path_, tleaf in paths_and_leaves:
+                key = _path_str(path_)
+                entry = metas.get(key)
+                if entry is None:
+                    raise KeyError(f"sharded checkpoint missing leaf {key!r}")
+                if entry["kind"] == "prngkey":
+                    leaves.append(jax.random.wrap_key_data(
+                        np.asarray(_leaf_from_pieces(entry, loads))))
+                    continue
+                if tuple(entry["shape"]) != tuple(
+                        getattr(tleaf, "shape", entry["shape"])):
+                    raise ValueError(
+                        f"checkpoint leaf {key!r} shape {entry['shape']} != "
+                        f"template {tleaf.shape}")
+                if hasattr(tleaf, "dtype") and \
+                        str(entry["dtype"]) != str(tleaf.dtype):
+                    raise ValueError(
+                        f"checkpoint leaf {key!r} dtype {entry['dtype']} != "
+                        f"template {tleaf.dtype}: restore with the same "
+                        "param_dtype the checkpoint was written with")
+                if (isinstance(tleaf, jax.Array)
+                        and not tleaf.is_fully_addressable):
+                    # selective read: each distinct wanted region is read
+                    # (or assembled) ONCE, then placed per device. When
+                    # every wanted region exactly matches a saved piece
+                    # (same mesh on resume — the common case) no global
+                    # assembly happens; otherwise the leaf is assembled
+                    # once and sliced (resharding restore).
+                    shape = tuple(entry["shape"])
+                    dtype = entry["dtype"]
+                    idx_map = tleaf.sharding.devices_indices_map(shape)
+                    devs = list(tleaf.sharding.addressable_devices)
+                    wants = {dev: _norm_index(idx_map[dev], shape)
+                             for dev in devs}
+                    by_bounds = {
+                        tuple((s, s + d) for s, d in
+                              zip(p["start"], p["shape"])): p
+                        for p in entry["pieces"]}
+                    region: dict = {}
+                    distinct = set(wants.values())
+                    if all(w in by_bounds for w in distinct):
+                        for w in distinct:
+                            p = by_bounds[w]
+                            region[w] = _view_dtype(
+                                loads[p["file"]][p["key"]], dtype)
+                    else:
+                        full = _leaf_from_pieces(entry, loads)
+                        for w in distinct:
+                            region[w] = full[tuple(slice(a, b)
+                                                   for a, b in w)]
+                    singles = [jax.device_put(region[wants[dev]], dev)
+                               for dev in devs]
+                    leaves.append(jax.make_array_from_single_device_arrays(
+                        shape, tleaf.sharding, singles))
+                else:
+                    arr = _leaf_from_pieces(entry, loads)
+                    if isinstance(tleaf, jax.Array):
+                        leaves.append(jax.device_put(arr, tleaf.sharding))
+                    else:
+                        leaves.append(jax.numpy.asarray(arr))
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        finally:
+            for z in loads.values():
+                z.close()
 
 
 def latest_checkpoint(directory: str) -> str | None:
-    """Path of the newest checkpoint (tf.train.latest_checkpoint parity)."""
+    """Path of the newest checkpoint (tf.train.latest_checkpoint parity).
+    For a sharded checkpoint this is its ``.shards.json`` anchor."""
     mgr = CheckpointManager(directory)
     step = mgr.latest_step()
-    return mgr.checkpoint_path(step) if step is not None else None
+    if step is None:
+        return None
+    single = mgr.checkpoint_path(step)
+    return single if os.path.exists(single) else mgr.shard_anchor_path(step)
 
 
 def _agreed_latest_step(manager: CheckpointManager) -> int | None:
@@ -310,8 +636,7 @@ def _agreed_latest_step(manager: CheckpointManager) -> int | None:
     chief = int(multihost_utils.broadcast_one_to_all(
         np.int64(-1 if local is None else local)))
     chief_step = None if chief < 0 else chief
-    if chief_step is not None and not os.path.exists(
-            manager.checkpoint_path(chief_step)):
+    if chief_step is not None and not manager._anchor_exists(chief_step):
         raise FileNotFoundError(
             f"process {jax.process_index()} cannot read checkpoint step "
             f"{chief_step} that process 0 will restore: the checkpoint "

@@ -96,6 +96,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--async_save", action="store_true",
                    help="write checkpoints on a background thread (the "
                         "reference's checkpoint-thread behavior)")
+    p.add_argument("--sharded_save", action="store_true",
+                   help="sharded checkpoints (TF Saver sharded=True "
+                        "parity): each host writes only the parameter "
+                        "shards it owns, in parallel — no cross-host "
+                        "gather; restore reads back selectively")
     p.add_argument("--log_every_steps", type=int, default=100)
     p.add_argument("--summary_every_steps", type=int, default=0,
                    help="scalar-summary cadence to the metrics JSONL "
@@ -173,7 +178,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             save_steps=args.save_steps,
             save_secs=args.save_secs,
             keep_checkpoint_every_n_hours=args.keep_checkpoint_every_n_hours,
-            async_save=args.async_save),
+            async_save=args.async_save,
+            sharded=args.sharded_save),
         obs=ObservabilityConfig(
             log_every_steps=args.log_every_steps,
             summary_every_steps=args.summary_every_steps,

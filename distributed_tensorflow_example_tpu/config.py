@@ -108,6 +108,10 @@ class CheckpointConfig:
     save_secs: float = 0.0          # save every T seconds (0 disables time-based)
     keep_checkpoint_every_n_hours: float = 0.0
     async_save: bool = False
+    sharded: bool = False           # per-process shard files (TF Saver
+                                    # sharded=True analogue): each host
+                                    # writes only the pieces it owns — no
+                                    # cross-host gather on save
 
 
 @dataclasses.dataclass

@@ -81,7 +81,8 @@ class Trainer:
                               max_to_keep=config.checkpoint.max_to_keep,
                               keep_every_n_hours=(
                                   config.checkpoint.keep_checkpoint_every_n_hours),
-                              async_save=config.checkpoint.async_save)
+                              async_save=config.checkpoint.async_save,
+                              sharded=config.checkpoint.sharded)
             if config.checkpoint.directory else None)
         self.metrics_logger = MetricsLogger(config.obs.metrics_path,
                                             tb_logdir=config.obs.tb_logdir)

@@ -11,6 +11,10 @@ Covered (all unreachable from process_count=1 tests):
 - checkpoint save through ``process_allgather`` of non-addressable
   (cross-process-replicated, fsdp-sharded) arrays + the broadcast
   restore-or-init decision
+- SHARDED checkpoint save/restore (fsdp=8 spanning both processes):
+  each process writes exactly its own disjoint piece set, the two-phase
+  commit barriers, and the selective piece-wise restore reassembles the
+  identical state (asserted inside the worker)
 - coordination-service ``barrier()``
 """
 
