@@ -55,6 +55,76 @@ def test_param_dtype_bf16_still_trains():
     assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
 
 
+@pytest.mark.parametrize("opt", ["momentum", "adam", "adamw"])
+def test_moment_dtype_bf16_lands_in_opt_state(opt):
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    mesh = local_mesh(1)
+    tx = make_optimizer(OptimizerConfig(name=opt, learning_rate=1e-3,
+                                        moment_dtype="bfloat16"))
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init, seed=0)
+    dtypes = {np.dtype(l.dtype)
+              for l in jax.tree_util.tree_leaves(state.opt_state)
+              if hasattr(l, "dtype") and jnp.issubdtype(l.dtype,
+                                                        jnp.floating)}
+    assert np.dtype(jnp.bfloat16) in dtypes, dtypes
+    if opt in ("adam", "adamw"):
+        # nu must STAY f32: its sqrt scales the update directly
+        assert np.dtype(np.float32) in dtypes, dtypes
+    b = m.dummy_batch(8)
+    losses = []
+    for _ in range(5):
+        state, metr = sync.step(state, sync.shard_batch(b))
+        losses.append(float(metr["loss"]))
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+
+
+def test_moment_dtype_bf16_checkpoint_roundtrip(tmp_path):
+    from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+        CheckpointManager)
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    mesh = local_mesh(1)
+    tx = make_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3,
+                                        moment_dtype="bfloat16"))
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init, seed=0)
+    state, _ = sync.step(state, sync.shard_batch(m.dummy_batch(8)))
+    for sharded in (False, True):
+        mgr = CheckpointManager(str(tmp_path / f"s{sharded}"),
+                                sharded=sharded)
+        mgr.save(state, 1)
+        restored = mgr.restore(jax.tree_util.tree_map(lambda x: x, state), 1)
+        for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                        jax.tree_util.tree_leaves(restored.opt_state)):
+            assert a.dtype == b.dtype
+            assert jnp.array_equal(a, b)
+
+
+def test_default_moment_dtype_stays_f32_under_bf16_params():
+    """moment_dtype='float32' must PIN mu to f32 even when params are
+    bf16 (optax's None default would silently follow the param dtype)."""
+    m = get_model("mlp", TrainConfig(model="mlp", param_dtype="bfloat16"))
+    mesh = local_mesh(1)
+    tx = make_optimizer(OptimizerConfig(name="adam", learning_rate=1e-3))
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init, seed=0)
+    import optax
+    adam_states = [s for s in jax.tree_util.tree_leaves(
+        state.opt_state,
+        is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))
+        if isinstance(s, optax.ScaleByAdamState)]
+    mu_leaves = jax.tree_util.tree_leaves([s.mu for s in adam_states])
+    assert mu_leaves
+    for l in mu_leaves:
+        assert l.dtype == jnp.float32, l.dtype
+
+
+def test_moment_dtype_rejects_garbage():
+    with pytest.raises(ValueError, match="moment_dtype"):
+        make_optimizer(OptimizerConfig(name="adam",
+                                       moment_dtype="float16x"))
+
+
 def test_total_num_replicas_mismatch_raises():
     m = get_model("mlp", TrainConfig(model="mlp"))
     mesh = local_mesh(2, {"data": 2})
