@@ -151,9 +151,13 @@ def main() -> None:
     extra: dict[str, float | None] = {}
 
     if only is None or "mnist" in only:
+        # 1000 steps = 50 measured dispatches: at 0.55 ms/step the whole
+        # measurement is ~0.6 s, and 10 dispatches (the old 200-step run)
+        # left the number at the mercy of axon-tunnel latency jitter
+        # (observed 12.8M-15.0M eps swings; BASELINE.md "discrepancy" note)
         eps, ms, mfu = _run(
-            "mlp", batch=8192, steps=200 if on_tpu else 10,
-            warmup=40 if on_tpu else 2,
+            "mlp", batch=8192, steps=1000 if on_tpu else 10,
+            warmup=100 if on_tpu else 2,
             opt=OptimizerConfig(name="sgd", learning_rate=0.5),
             make_batch=_mnist_batch,
             steps_per_call=20 if on_tpu else 5)
