@@ -1,0 +1,48 @@
+"""examples/mnist_distributed.py — the reference-shaped trainer script
+(SURVEY.md §2.1/§3.1: flags -> ClusterSpec -> Server -> ps|worker branch
+-> placement -> sync optimizer -> supervised loop) must actually run as a
+user would run it: as a subprocess, both branches.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+_EXAMPLE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples", "mnist_distributed.py")
+
+
+def _run(args, timeout=300):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([sys.executable, _EXAMPLE, *args],
+                          env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_worker_trains_saves_and_resumes(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    r = _run(["--train_steps", "120", "--log_every_steps", "60",
+              "--batch_size", "256", "--ckpt_dir", ckpt])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "step 120" in r.stdout
+    m = re.search(r"final test accuracy: ([\d.]+)", r.stdout)
+    assert m and float(m.group(1)) >= 0.95, r.stdout
+    assert any(f.startswith("ckpt-120") for f in os.listdir(ckpt))
+
+    # resume: restore-or-init must pick up step 120 and fast-forward
+    r2 = _run(["--train_steps", "180", "--log_every_steps", "60",
+               "--batch_size", "256", "--ckpt_dir", ckpt])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "restored checkpoint at step 120" in r2.stdout
+    assert "step 180" in r2.stdout
+
+
+def test_ps_branch_exits_zero_with_notice():
+    r = _run(["--job_name", "ps", "--task_index", "0",
+              "--ps_hosts", "ps0:2222", "--worker_hosts", "w0:2222"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout + r.stderr
+    assert "No PS role on TPU" in out
