@@ -115,6 +115,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write TensorBoard scalar event files here "
                         "(tf.summary FileWriter parity; no TF dependency)")
     p.add_argument("--eval_every_steps", type=int, default=0)
+    p.add_argument("--eval_only", action="store_true",
+                   help="no training: restore the latest checkpoint from "
+                        "--ckpt_dir (or --eval_step N), run the eval "
+                        "pass, print one JSON metrics line, exit")
+    p.add_argument("--eval_step", type=int, default=None,
+                   help="checkpoint step to evaluate (--eval_only; "
+                        "default: latest)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--check_nans", action="store_true",
                    help="stop on non-finite loss (NanTensorHook parity; "
@@ -200,13 +207,22 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
 
 
-def load_dataset(cfg: TrainConfig, model=None):
+def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
     """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts.
 
     Dataset defaults follow the model (BASELINE.json:7-11 pairings):
     mlp/lenet → MNIST, resnet20 → CIFAR-10, resnet50 → ImageNet.
+
+    ``eval_only`` skips materializing the train split where that is
+    expensive (ImageNet folder decode / streaming pool) and returns
+    ``(None, eval_arrays)`` for those datasets.
     """
     name = cfg.data.dataset
+    if eval_only and name in ("resnet50", "imagenet") \
+            and not cfg.data.synthetic and cfg.data.data_dir:
+        from ..data.imagenet import load_imagenet_folder
+        v = load_imagenet_folder(cfg.data.data_dir, "val")
+        return None, {"x": v["val_x"], "y": v["val_y"]}
     if name in ("mlp", "pipe_mlp", "mnist", "lenet"):
         from ..data.mnist import get_mnist
         # arrays stay flat-784; models normalize input shape themselves
@@ -264,6 +280,10 @@ def load_dataset(cfg: TrainConfig, model=None):
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.eval_only and not args.ckpt_dir:
+        # fail fast: everything below (dataset load, mesh, Trainer) can
+        # take minutes for the big datasets
+        raise SystemExit("--eval_only requires --ckpt_dir")
 
     cluster = None
     if args.ps_hosts or args.worker_hosts:
@@ -286,11 +306,42 @@ def main(argv: list[str] | None = None) -> int:
     from ..train.trainer import Trainer
 
     model = get_model(cfg.model, cfg)
-    train_arrays, eval_arrays = load_dataset(cfg, model)
+    train_arrays, eval_arrays = load_dataset(cfg, model,
+                                             eval_only=args.eval_only)
     ctx = server.context
     trainer = Trainer(model, cfg, train_arrays, eval_arrays,
                       process_index=ctx.process_index if ctx else 0,
                       num_processes=ctx.num_processes if ctx else 1)
+
+    if args.eval_only:
+        # standalone evaluate-a-checkpoint path: the reference's final
+        # test-accuracy pass (SURVEY.md §2.1) without the training run
+        if eval_arrays is None:
+            raise SystemExit("--eval_only: no eval split for this dataset")
+        import jax
+
+        from ..ckpt.checkpoint import _agreed_latest_step
+        with trainer:
+            # the step choice must agree across processes (broadcast from
+            # process 0) exactly like restore_or_init — per-process
+            # "latest" can diverge on a lagging shared filesystem
+            step = (args.eval_step if args.eval_step is not None
+                    else _agreed_latest_step(trainer.ckpt_manager))
+            if step is None:
+                raise SystemExit(
+                    f"--eval_only: no checkpoint under {args.ckpt_dir!r}")
+            template = trainer.sync.init(model.init, seed=cfg.seed)
+            try:
+                state = trainer.ckpt_manager.restore(template, step=step)
+            except FileNotFoundError as e:
+                raise SystemExit(f"--eval_only: {e}")
+            metrics = trainer.evaluate(state)
+        import json as _json
+        print(_json.dumps({"step": int(jax.device_get(state.step)),
+                           **{k: round(float(v), 6)
+                              for k, v in metrics.items()}}), flush=True)
+        return 0
+
     with trainer:
         state, summary = trainer.train()
 

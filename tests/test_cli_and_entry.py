@@ -28,6 +28,34 @@ def test_cli_trains_mlp(tmp_path):
     assert any("steps_per_sec" in l for l in lines)
 
 
+def test_cli_eval_only(tmp_path, capsys):
+    """--eval_only restores the checkpoint and prints one JSON metrics
+    line (the reference's final test-accuracy pass without training)."""
+    from distributed_tensorflow_example_tpu.cli.train import main
+    rc = main(["--model=mlp", "--train_steps=60", "--batch_size=256",
+               "--learning_rate=0.5", f"--ckpt_dir={tmp_path}/ckpt",
+               "--save_steps=60"])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["--model=mlp", "--eval_only", f"--ckpt_dir={tmp_path}/ckpt"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["step"] == 60
+    assert out["accuracy"] >= 0.9
+
+
+def test_cli_eval_only_requires_ckpt_dir():
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="ckpt_dir"):
+        main(["--model=mlp", "--eval_only"])
+
+
+def test_cli_eval_only_missing_checkpoint_errors(tmp_path):
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="no checkpoint"):
+        main(["--model=mlp", "--eval_only", f"--ckpt_dir={tmp_path}/none"])
+
+
 def test_cli_unknown_dataset_errors():
     from distributed_tensorflow_example_tpu.cli.train import main
     with pytest.raises(SystemExit):
