@@ -132,6 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "the step where it occurs (debug-only cost)")
     p.add_argument("--debug_nans", action="store_true",
                    help="enable jax_debug_nans (eager NaN tracebacks)")
+    p.add_argument("--profiler_port", type=int, default=0,
+                   help="host a live profiler service on port + "
+                        "process_index (the reference server's "
+                        "ProfilerService parity; attach TensorBoard's "
+                        "profile plugin on demand)")
     p.add_argument("--profile_dir", default=None)
     p.add_argument("--profile_steps", default=None,
                    help="start,stop step range for the profiler hook")
@@ -293,7 +298,8 @@ def main(argv: list[str] | None = None) -> int:
         })
 
     from ..runtime.server import Server
-    server = Server(cluster, args.job_name, args.task_index)
+    server = Server(cluster, args.job_name, args.task_index,
+                    profiler_port=args.profiler_port or None)
     if not server.role.should_run:          # ps branch: notice + exit 0
         server.join()
         return 0

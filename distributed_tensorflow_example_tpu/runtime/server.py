@@ -13,6 +13,11 @@ work to :mod:`.distributed`:
   themselves; there is no service thread to wait on).
 - ps tasks: ``join()`` logs the no-PS-on-TPU notice and returns, so the
   reference's ``if job_name == "ps": server.join()`` pattern exits cleanly.
+- ``profiler_port``: the reference's GrpcServer hosted a ProfilerService
+  on every server (grpc_server_lib.h:42,:232-233 per SURVEY.md §5.1);
+  the TPU-native equivalent is ``jax.profiler.start_server`` — point
+  TensorBoard's profile plugin (or ``jax.profiler.trace``) at the port
+  for on-demand trace capture from a live training process.
 """
 
 from __future__ import annotations
@@ -32,12 +37,15 @@ class Server:
                  cluster: ClusterSpec | dict | None = None,
                  job_name: str = "worker",
                  task_index: int = 0,
-                 start: bool = True):
+                 start: bool = True,
+                 profiler_port: int | None = None):
         self.cluster = ClusterSpec(cluster) if cluster and not isinstance(cluster, ClusterSpec) else cluster
         self.job_name = job_name
         self.task_index = task_index
+        self.profiler_port = profiler_port
         self.role = resolve_legacy_role(self.cluster, job_name, task_index)
         self._context: distributed.DistributedContext | None = None
+        self._profiler_server = None
         if start:
             self.start()
 
@@ -45,6 +53,22 @@ class Server:
         if self._context is None and self.role.should_run:
             self._context = distributed.initialize(
                 self.cluster, self.job_name, self.task_index)
+        if (self.profiler_port and self._profiler_server is None
+                and self.role.should_run):
+            import jax.profiler
+            # per-process offset: the same launch command with different
+            # task indices must not collide when workers share a host
+            # (the reference gave every task its own server port)
+            port = self.profiler_port + (
+                self._context.process_index if self._context else 0)
+            try:
+                self._profiler_server = jax.profiler.start_server(port)
+                log.info("profiler service listening on port %d "
+                         "(TensorBoard profile plugin / "
+                         "jax.profiler.trace)", port)
+            except Exception as e:       # profiling is auxiliary: a bind
+                log.warning("profiler service failed to start on port "
+                            "%d: %s — continuing without it", port, e)
 
     @property
     def context(self) -> distributed.DistributedContext | None:

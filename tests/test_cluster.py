@@ -62,3 +62,35 @@ def test_server_parity_handles():
     ps = Server(CLUSTER, job_name="ps", task_index=0)
     ps.join()  # logs notice, returns — old launch scripts exit 0
     assert not ps.role.should_run
+
+
+def test_profiler_service_port_listens():
+    """profiler_port hosts a live jax.profiler server (the reference
+    GrpcServer's ProfilerService parity, SURVEY.md §5.1). Subprocess: the
+    profiler server lives for the process lifetime once started."""
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    code = textwrap.dedent("""
+        import os, socket, sys
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.path.insert(0, sys.argv[1])
+        from distributed_tensorflow_example_tpu.runtime.server import Server
+        # pick the free port HERE (not in the parent) so the bind window
+        # is microseconds, not the subprocess startup time
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        Server(None, "worker", 0, profiler_port=port)
+        with socket.create_connection(("127.0.0.1", port), timeout=5):
+            print("PORT-OPEN")
+    """)
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", code, repo],
+                       capture_output=True, text=True, timeout=180)
+    assert "PORT-OPEN" in r.stdout, r.stderr[-1000:]
