@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streaming", action="store_true",
                    help="decode-per-batch streaming input pipeline "
                         "(bounded memory; ImageNet-scale folder trees)")
+    p.add_argument("--augment", action="store_true",
+                   help="training augmentation: random-resized crop + "
+                        "horizontal flip (the standard ResNet ImageNet "
+                        "recipe; requires --streaming, train split only)")
     p.add_argument("--max_per_class", type=int, default=None,
                    help="cap eagerly-decoded images per class (ImageNet "
                         "folder loading; full train split is ~770GB as f32)")
@@ -179,7 +183,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                         batch_size=args.batch_size, seed=args.seed,
                         native=args.native, seq_len=args.seq_len,
                         max_per_class=args.max_per_class,
-                        streaming=args.streaming),
+                        streaming=args.streaming, augment=args.augment),
         optimizer=OptimizerConfig(name=args.optimizer,
                                   learning_rate=args.learning_rate,
                                   momentum=args.momentum,
@@ -223,6 +227,10 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
     ``(None, eval_arrays)`` for those datasets.
     """
     name = cfg.data.dataset
+    if cfg.data.augment and name not in ("resnet50", "imagenet"):
+        raise SystemExit(
+            f"--augment is the ImageNet recipe; dataset {name!r} has no "
+            "augmentation pipeline")
     if eval_only and name in ("resnet50", "imagenet") \
             and not cfg.data.synthetic and cfg.data.data_dir:
         from ..data.imagenet import load_imagenet_folder
@@ -248,9 +256,17 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
             from ..data.streaming import StreamingSource
             train_src = StreamingSource(
                 cfg.data.data_dir, "train",
-                max_per_class=cfg.data.max_per_class)
+                max_per_class=cfg.data.max_per_class,
+                augment=cfg.data.augment)
             v = load_imagenet_folder(cfg.data.data_dir, "val")
             return train_src, {"x": v["val_x"], "y": v["val_y"]}
+        if cfg.data.augment:
+            # eager arrays are decoded once: augmentation needs the
+            # per-epoch decode the streaming pipeline provides
+            raise SystemExit(
+                "--augment is not supported with --synthetic"
+                if cfg.data.synthetic or not cfg.data.data_dir
+                else "--augment requires --streaming")
         from ..data.imagenet import get_imagenet
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)

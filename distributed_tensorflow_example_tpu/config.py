@@ -32,6 +32,9 @@ class DataConfig:
     streaming: bool = False         # decode-per-batch thread-pool pipeline
                                     # (data/streaming.py) instead of eager
                                     # whole-split decode — ImageNet scale
+    augment: bool = False           # training augmentation (random-resized
+                                    # crop + flip, the ResNet recipe);
+                                    # streaming ImageNet only
     # BERT-only knobs
     seq_len: int = 128
     vocab_size: int = 30522

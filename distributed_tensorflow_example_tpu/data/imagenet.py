@@ -12,6 +12,7 @@ textures so ResNet-50 end-to-end runs and benchmarks need no dataset.
 
 from __future__ import annotations
 
+import math
 import os
 
 import numpy as np
@@ -37,6 +38,42 @@ def decode_image(path: str, image_size: int) -> np.ndarray:
     left, top = (w - image_size) // 2, (h - image_size) // 2
     img = img.crop((left, top, left + image_size, top + image_size))
     return np.asarray(img, np.float32) / 255.0
+
+
+def augment_image(path: str, image_size: int,
+                  rng: np.random.Generator) -> np.ndarray:
+    """Training augmentation: random-resized crop (scale 0.08–1.0, ratio
+    3/4–4/3 — the standard ResNet ImageNet recipe) + horizontal flip,
+    -> [S,S,3] f32 in [0,1].
+
+    Determinism: the caller derives ``rng`` from (seed, epoch, global
+    image index), so the augmented pixel stream is independent of process
+    count and batch composition, and exact-resume replays it bit-exactly.
+    """
+    from PIL import Image
+    img = Image.open(path).convert("RGB")
+    w, h = img.size
+    area = float(w * h)
+    crop = None
+    for _ in range(10):
+        target = area * rng.uniform(0.08, 1.0)
+        ratio = math.exp(rng.uniform(math.log(3 / 4), math.log(4 / 3)))
+        cw = int(round(math.sqrt(target * ratio)))
+        ch = int(round(math.sqrt(target / ratio)))
+        if 0 < cw <= w and 0 < ch <= h:
+            left = int(rng.integers(0, w - cw + 1))
+            top = int(rng.integers(0, h - ch + 1))
+            crop = img.crop((left, top, left + cw, top + ch))
+            break
+    if crop is None:                       # degenerate aspect: center crop
+        side = min(w, h)
+        left, top = (w - side) // 2, (h - side) // 2
+        crop = img.crop((left, top, left + side, top + side))
+    arr = np.asarray(crop.resize((image_size, image_size)),
+                     np.float32) / 255.0
+    if rng.random() < 0.5:
+        arr = arr[:, ::-1]
+    return np.ascontiguousarray(arr)
 
 
 def index_image_folder(data_dir: str, split: str = "train", *,

@@ -16,9 +16,13 @@ graph (SURVEY.md §2.2 Coordinator/QueueRunner, §2.1 input pipeline):
 
 Determinism contract — identical to ``ShardedLoader`` (loader.py): seeded
 per-epoch shuffle of the GLOBAL index, each process takes its contiguous
-slice, so the global batch sequence is independent of process count and
-bit-identical to the eager path over the same files (the shared
-``imagenet.decode_image`` guarantees identical pixels). Exact-resume
+slice, so the global batch sequence is independent of process count and —
+with ``augment=False`` — bit-identical to the eager path over the same
+files (the shared ``imagenet.decode_image`` guarantees identical pixels).
+``augment=True`` (random-resized crop + flip) intentionally departs from
+the eager pixels but keeps every determinism property: the per-image rng
+keys on (seed, epoch, global index), so the augmented stream is still
+process-count independent and replays bit-exactly on resume. Exact-resume
 fast-forward works through the same ``epoch``/``steps_per_epoch``
 interface.
 """
@@ -30,7 +34,7 @@ from typing import Iterator
 
 import numpy as np
 
-from .imagenet import decode_image, index_image_folder
+from .imagenet import augment_image, decode_image, index_image_folder
 from .loader import Batch, PrefetchIterator
 
 
@@ -48,7 +52,8 @@ class StreamingImageFolder:
                  global_batch: int = 128,
                  process_index: int = 0, num_processes: int = 1,
                  shuffle: bool = True, seed: int = 0,
-                 decode_threads: int = 8):
+                 decode_threads: int = 8,
+                 augment: bool = False):
         if global_batch % num_processes:
             raise ValueError(
                 f"global_batch {global_batch} not divisible by "
@@ -69,6 +74,7 @@ class StreamingImageFolder:
         self.num_processes = num_processes
         self.shuffle = shuffle
         self.seed = seed
+        self.augment = augment
         self.epoch = 0
         self._pool = ThreadPoolExecutor(max_workers=max(1, decode_threads))
 
@@ -76,9 +82,18 @@ class StreamingImageFolder:
     def steps_per_epoch(self) -> int:
         return self.n // self.global_batch      # always drop_remainder
 
-    def _decode(self, indices: np.ndarray) -> Batch:
-        xs = list(self._pool.map(
-            lambda i: decode_image(self.paths[i], self.image_size), indices))
+    def _decode(self, indices: np.ndarray, epoch: int) -> Batch:
+        if self.augment:
+            # per-image rng from (seed, epoch, global index): the
+            # augmented stream is process-count independent and replays
+            # bit-exactly on resume
+            def one(i):
+                rng = np.random.default_rng([self.seed, epoch, int(i)])
+                return augment_image(self.paths[i], self.image_size, rng)
+        else:
+            def one(i):
+                return decode_image(self.paths[i], self.image_size)
+        xs = list(self._pool.map(one, indices))
         return {"x": np.stack(xs), "y": self.labels[indices]}
 
     def epoch_batches(self, epoch: int | None = None,
@@ -91,7 +106,7 @@ class StreamingImageFolder:
             g0 = b * self.global_batch
             gidx = idx[g0:g0 + self.global_batch]
             l0 = self.process_index * self.local_batch
-            yield self._decode(gidx[l0:l0 + self.local_batch])
+            yield self._decode(gidx[l0:l0 + self.local_batch], epoch)
 
     def skip(self, start_step: int) -> None:
         """Exact-resume fast-forward WITHOUT decoding the skipped batches
@@ -120,13 +135,15 @@ class StreamingSource:
 
     def __init__(self, data_dir: str, split: str = "train", *,
                  image_size: int = 224, max_per_class: int | None = None,
-                 prefetch: int = 2, decode_threads: int = 8):
+                 prefetch: int = 2, decode_threads: int = 8,
+                 augment: bool = False):
         self.data_dir = data_dir
         self.split = split
         self.image_size = image_size
         self.max_per_class = max_per_class
         self.prefetch = prefetch
         self.decode_threads = decode_threads
+        self.augment = augment
         self._folder: StreamingImageFolder | None = None
 
     def make_loader(self, global_batch: int, *, start_step: int = 0,
@@ -139,7 +156,8 @@ class StreamingSource:
             self.data_dir, self.split, image_size=self.image_size,
             max_per_class=self.max_per_class, global_batch=global_batch,
             process_index=process_index, num_processes=num_processes,
-            shuffle=shuffle, seed=seed, decode_threads=self.decode_threads)
+            shuffle=shuffle, seed=seed, decode_threads=self.decode_threads,
+            augment=self.augment)
         if start_step > 0:
             self._folder.skip(start_step)
         it = iter(self._folder)
