@@ -14,7 +14,13 @@ capture to the numbers a perf investigation actually starts from:
 Usage::
 
     python -m distributed_tensorflow_example_tpu.utils.trace_summary \
-        /tmp/trace_dir [--top 20] [--json]
+        /tmp/trace_dir [--top 20] [--json] [--chrome out.trace.json]
+
+``--chrome`` additionally emits the capture as a chrome://tracing /
+Perfetto-loadable trace-event JSON — the direct analogue of the
+reference's ``timeline.Timeline.generate_chrome_trace_format``
+(SURVEY.md §5.1): one process per xplane device plane, one thread per
+line, complete ("X") events in microseconds.
 
 Parsing needs the xplane proto, vendored by the locally installed
 TensorFlow wheel (``tensorflow.tsl.profiler.protobuf``) — an OPTIONAL
@@ -79,6 +85,17 @@ def _union_ms(intervals: list[tuple[int, int]]) -> float:
     return total / 1e9
 
 
+def _defining_name(full_instruction: str) -> str:
+    """The defining op name of an HLO instruction text (the part before
+    ' = ') — the one rule shared by the family bucketing and the chrome
+    export so the two views can never disagree."""
+    return full_instruction.split(" = ")[0]
+
+
+def _metadata_map(plane) -> dict[int, str]:
+    return {m.id: m.name for m in plane.event_metadata.values()}
+
+
 def _family(op_name: str) -> str:
     """Bucket by the DEFINING op name only — the event name is the full
     instruction text, so matching on the whole string would classify by
@@ -87,7 +104,7 @@ def _family(op_name: str) -> str:
     'fusion' time includes the MXU compute they contain — bound MXU time
     with the flops roofline (cost_analysis flops / peak), not with this
     breakdown."""
-    n = op_name.split(" = ")[0].lower()
+    n = _defining_name(op_name).lower()
     if "copy-start" in n or "copy-done" in n:
         return "async-copy"
     if "convolution" in n or n.startswith("%dot"):
@@ -104,10 +121,12 @@ def _family(op_name: str) -> str:
     return "other"
 
 
-def summarize(trace_dir: str, top: int = 20) -> dict[str, Any]:
+def summarize(trace_dir: str, top: int = 20,
+              spaces: list[tuple[str, Any]] | None = None) -> dict[str, Any]:
     """Returns {device: {lines: [...], ops_line: {...}}} for every
-    accelerator plane in the capture."""
-    spaces = _load_xspaces(trace_dir)
+    accelerator plane in the capture. ``spaces`` reuses already-parsed
+    xplanes (multi-host captures are hundreds of MB per host)."""
+    spaces = _load_xspaces(trace_dir) if spaces is None else spaces
     out: dict[str, Any] = {}
     for fname, xs in spaces:
         for plane in xs.planes:
@@ -123,7 +142,7 @@ def summarize(trace_dir: str, top: int = 20) -> dict[str, Any]:
 
 
 def _summarize_plane(out: dict[str, Any], key: str, plane, top: int) -> None:
-    meta = {m.id: m.name for m in plane.event_metadata.values()}
+    meta = _metadata_map(plane)
     lines = []
     ops_line: dict[str, Any] | None = None
     for line in plane.lines:
@@ -156,6 +175,65 @@ def _summarize_plane(out: dict[str, Any], key: str, plane, top: int) -> None:
         out[key] = {"lines": lines, "ops": ops_line}
 
 
+def chrome_trace(trace_dir: str, *,
+                 max_events_per_line: int | None = None,
+                 spaces: list[tuple[str, Any]] | None = None
+                 ) -> dict[str, Any]:
+    """Convert a jax.profiler capture into chrome trace-event JSON
+    (the reference timeline.py's output format, SURVEY.md §5.1).
+
+    Every xplane plane becomes a chrome 'process', every line a
+    'thread'; events are complete ("X") events with microsecond
+    timestamps. Event times are absolute — ``XEvent.offset_ps`` is
+    relative to its line's ``timestamp_ns``, so the line base is added
+    back (then the capture's minimum is subtracted to keep numbers
+    small) — which is what makes cross-line/cross-host alignment in the
+    viewer correct. Perfetto and chrome://tracing load the result
+    directly. ``max_events_per_line`` truncates pathologically dense
+    lines (the longest captures carry hundreds of thousands of events).
+    """
+    spaces = _load_xspaces(trace_dir) if spaces is None else spaces
+    bases = [line.timestamp_ns * 1000                     # ns -> ps
+             for _, xs in spaces for plane in xs.planes
+             for line in plane.lines if line.events]
+    if not bases:
+        raise RuntimeError("no planes with events found in the capture")
+    t0_ps = min(bases)
+
+    events: list[dict[str, Any]] = []
+    pid = 0
+    for fname, xs in spaces:
+        for plane in xs.planes:
+            if not plane.lines:
+                continue
+            pid += 1
+            events.append({"ph": "M", "pid": pid, "name": "process_name",
+                           "args": {"name": f"{fname}:{plane.name}"}})
+            meta = _metadata_map(plane)
+            for tid, line in enumerate(plane.lines, start=1):
+                events.append({"ph": "M", "pid": pid, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": line.name}})
+                line_events = line.events
+                if max_events_per_line is not None:
+                    line_events = sorted(
+                        line_events, key=lambda e: -e.duration_ps
+                    )[:max_events_per_line]
+                base_ps = line.timestamp_ns * 1000 - t0_ps
+                for ev in line_events:
+                    full = meta.get(ev.metadata_id, "?")
+                    events.append({
+                        "ph": "X", "pid": pid, "tid": tid,
+                        # HLO event names are whole instruction texts;
+                        # the defining op name is the readable label
+                        "name": _defining_name(full)[:120],
+                        "ts": (base_ps + ev.offset_ps) / 1e6,  # ps -> us
+                        "dur": max(ev.duration_ps / 1e6, 0.001),
+                        "args": {"full_name": full[:400]},
+                    })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 def format_text(summary: dict[str, Any]) -> str:
     parts = []
     for dev, rec in summary.items():
@@ -179,9 +257,23 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("trace_dir")
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--chrome", metavar="OUT_JSON", default=None,
+                    help="also write a chrome://tracing / Perfetto trace "
+                         "(timeline.py parity)")
+    ap.add_argument("--max_events_per_line", type=int, default=None,
+                    help="keep only the N longest events per line in the "
+                         "chrome trace (dense captures)")
     args = ap.parse_args(argv)
-    s = summarize(args.trace_dir, top=args.top)
+    spaces = _load_xspaces(args.trace_dir)     # parse once, use twice
+    s = summarize(args.trace_dir, top=args.top, spaces=spaces)
     print(json.dumps(s, indent=1) if args.json else format_text(s))
+    if args.chrome:
+        trace = chrome_trace(args.trace_dir, spaces=spaces,
+                             max_events_per_line=args.max_events_per_line)
+        with open(args.chrome, "w") as f:
+            json.dump(trace, f)
+        print(f"chrome trace: {args.chrome} "
+              f"({len(trace['traceEvents'])} events)")
     return 0
 
 
