@@ -25,6 +25,11 @@ from ..utils.logging import get_logger
 
 log = get_logger("cli")
 
+# dataset-name aliases (one definition: the --augment gate, the dataset
+# dispatch, and the transform wiring must never disagree)
+CIFAR_DATASETS = ("resnet20", "cifar10", "cifar")
+IMAGENET_DATASETS = ("resnet50", "imagenet")
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -45,9 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode-per-batch streaming input pipeline "
                         "(bounded memory; ImageNet-scale folder trees)")
     p.add_argument("--augment", action="store_true",
-                   help="training augmentation: random-resized crop + "
-                        "horizontal flip (the standard ResNet ImageNet "
-                        "recipe; requires --streaming, train split only)")
+                   help="training augmentation (train split only): "
+                        "ImageNet random-resized crop + flip (requires "
+                        "--streaming) or CIFAR pad-4 crop + flip")
     p.add_argument("--max_per_class", type=int, default=None,
                    help="cap eagerly-decoded images per class (ImageNet "
                         "folder loading; full train split is ~770GB as f32)")
@@ -227,11 +232,12 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
     ``(None, eval_arrays)`` for those datasets.
     """
     name = cfg.data.dataset
-    if cfg.data.augment and name not in ("resnet50", "imagenet"):
+    if cfg.data.augment and name not in (CIFAR_DATASETS
+                                         + IMAGENET_DATASETS):
         raise SystemExit(
-            f"--augment is the ImageNet recipe; dataset {name!r} has no "
-            "augmentation pipeline")
-    if eval_only and name in ("resnet50", "imagenet") \
+            f"--augment is an image-training recipe; dataset {name!r} "
+            "has no augmentation pipeline")
+    if eval_only and name in IMAGENET_DATASETS \
             and not cfg.data.synthetic and cfg.data.data_dir:
         from ..data.imagenet import load_imagenet_folder
         v = load_imagenet_folder(cfg.data.data_dir, "val")
@@ -241,10 +247,10 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
         # arrays stay flat-784; models normalize input shape themselves
         # (mlp flattens, lenet reshapes to NHWC)
         d = get_mnist(cfg.data.data_dir, cfg.data.synthetic)
-    elif name in ("resnet20", "cifar10", "cifar"):
+    elif name in CIFAR_DATASETS:
         from ..data.cifar import get_cifar10
         d = get_cifar10(cfg.data.data_dir, cfg.data.synthetic)
-    elif name in ("resnet50", "imagenet"):
+    elif name in IMAGENET_DATASETS:
         if cfg.data.streaming and not cfg.data.synthetic:
             if not cfg.data.data_dir:
                 raise SystemExit("--streaming requires --data_dir")
@@ -330,10 +336,17 @@ def main(argv: list[str] | None = None) -> int:
     model = get_model(cfg.model, cfg)
     train_arrays, eval_arrays = load_dataset(cfg, model,
                                              eval_only=args.eval_only)
+    train_transform = None
+    if cfg.data.augment and cfg.data.dataset in CIFAR_DATASETS:
+        # CIFAR pad-4-crop + flip is a loader transform (in-memory
+        # arrays); the ImageNet recipe lives in the streaming decode
+        from ..data.cifar import make_augment_transform
+        train_transform = make_augment_transform(cfg.data.seed)
     ctx = server.context
     trainer = Trainer(model, cfg, train_arrays, eval_arrays,
                       process_index=ctx.process_index if ctx else 0,
-                      num_processes=ctx.num_processes if ctx else 1)
+                      num_processes=ctx.num_processes if ctx else 1,
+                      train_transform=train_transform)
 
     if args.eval_only:
         # standalone evaluate-a-checkpoint path: the reference's final

@@ -32,9 +32,10 @@ class DataConfig:
     streaming: bool = False         # decode-per-batch thread-pool pipeline
                                     # (data/streaming.py) instead of eager
                                     # whole-split decode — ImageNet scale
-    augment: bool = False           # training augmentation (random-resized
-                                    # crop + flip, the ResNet recipe);
-                                    # streaming ImageNet only
+    augment: bool = False           # training augmentation, train split
+                                    # only: ImageNet random-resized crop +
+                                    # flip (streaming path), CIFAR pad-4
+                                    # crop + flip (loader transform)
     # BERT-only knobs
     seq_len: int = 128
     vocab_size: int = 30522

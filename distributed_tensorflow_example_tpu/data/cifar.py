@@ -78,3 +78,37 @@ def get_cifar10(data_dir: str | None, synthetic: bool = False,
     if data_dir and not synthetic:
         return load_cifar10(data_dir)
     return synthetic_cifar10(**synth_kw)
+
+
+def augment_batch(x: np.ndarray, *, epoch: int, indices: np.ndarray,
+                  seed: int, pad: int = 4) -> np.ndarray:
+    """The standard CIFAR ResNet recipe (He et al.): zero-pad ``pad`` px,
+    random HxW crop, horizontal flip with p=0.5.
+
+    Determinism: each image's rng keys on (seed, epoch, its GLOBAL
+    dataset index), so the augmented stream is process-count independent
+    and replays bit-exactly on resume — the same contract as the
+    streaming-ImageNet augmentation (data/streaming.py).
+    """
+    n, h, w, c = x.shape
+    padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    out = np.empty_like(x)
+    for j, i in enumerate(indices):
+        rng = np.random.default_rng([seed, epoch, int(i)])
+        dy = int(rng.integers(0, 2 * pad + 1))
+        dx = int(rng.integers(0, 2 * pad + 1))
+        img = padded[j, dy:dy + h, dx:dx + w]
+        if rng.random() < 0.5:
+            img = img[:, ::-1]
+        out[j] = img
+    return out
+
+
+def make_augment_transform(seed: int, pad: int = 4):
+    """ShardedLoader ``transform`` hook applying :func:`augment_batch`
+    to the ``x`` key (labels untouched)."""
+    def transform(batch, epoch, indices):
+        return dict(batch, x=augment_batch(batch["x"], epoch=epoch,
+                                           indices=indices, seed=seed,
+                                           pad=pad))
+    return transform

@@ -33,12 +33,18 @@ class ShardedLoader:
         identical on every process, as the reference's identical graph-side
         shuffling was.
       drop_remainder: keep batches full (static shapes for jit).
+      transform: optional per-batch hook ``transform(batch, epoch,
+        global_indices) -> batch`` — the augmentation seam. To keep the
+        determinism contract, a transform must key any randomness on
+        (its own seed, epoch, global index), never on call order.
     """
 
     def __init__(self, arrays: Batch, global_batch: int, *,
                  process_index: int = 0, num_processes: int = 1,
                  shuffle: bool = True, seed: int = 0,
-                 drop_remainder: bool = True):
+                 drop_remainder: bool = True,
+                 transform: Callable[[Batch, int, np.ndarray], Batch]
+                 | None = None):
         if global_batch % num_processes:
             raise ValueError(
                 f"global_batch {global_batch} not divisible by "
@@ -56,6 +62,7 @@ class ShardedLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
+        self.transform = transform
         self.epoch = 0
 
     @property
@@ -78,7 +85,10 @@ class ShardedLoader:
             # this process's contiguous slice of the global batch
             l0 = self.process_index * self.local_batch
             lidx = gidx[l0:l0 + self.local_batch]
-            yield {k: self.arrays[k][lidx] for k in self.keys}
+            batch = {k: self.arrays[k][lidx] for k in self.keys}
+            if self.transform is not None:
+                batch = self.transform(batch, epoch, lidx)
+            yield batch
 
     def __iter__(self) -> Iterator[Batch]:
         """Endless batches, advancing epochs (next_batch parity)."""
@@ -137,10 +147,18 @@ def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
     only the current epoch's prefix is discarded.
     """
     loader: ShardedLoader | None = None
-    if native and arrays:
+    if native and arrays and kw.get("transform") is not None:
+        import logging
+        logging.getLogger("dtx.loader").info(
+            "native loader bypassed: a batch transform (augmentation) "
+            "needs the Python path")
+    if native and arrays and kw.get("transform") is None:
+        # the C++ loader slices raw arrays; a transform needs the Python
+        # path (bit-identity between the two holds only untransformed)
         from . import native as native_mod
         if native_mod.available():
             kw.pop("drop_remainder", None)   # native is always drop_remainder
+            kw.pop("transform", None)        # None here (guard above)
             nat = native_mod.NativeLoader(arrays, global_batch, **kw)
             it = _fast_forward(nat, iter(nat), start_step)
             return it

@@ -59,12 +59,16 @@ class Trainer:
                  eval_arrays: dict[str, np.ndarray] | None = None,
                  *, mesh=None, hooks: list[hooks_lib.Hook] | None = None,
                  process_index: int | None = None,
-                 num_processes: int | None = None):
+                 num_processes: int | None = None,
+                 train_transform=None):
         self.model = model
         self.config = config
         self.mesh = mesh if mesh is not None else build_mesh(config.mesh)
         self.train_arrays = train_arrays
         self.eval_arrays = eval_arrays
+        # per-batch augmentation hook (ShardedLoader transform contract:
+        # randomness keyed on (seed, epoch, global index) only)
+        self.train_transform = train_transform
 
         if hasattr(model, "bind_mesh"):
             # mesh-aware models (pipeline stages; mirrors how ring
@@ -182,7 +186,8 @@ class Trainer:
             process_index=self.process_index,
             num_processes=self.num_processes,
             shuffle=self.config.data.shuffle,
-            seed=self.config.data.seed)
+            seed=self.config.data.seed,
+            transform=self.train_transform)
 
     # ------------------------------------------------------------------
     def train(self) -> tuple[TrainState, dict[str, Any]]:
