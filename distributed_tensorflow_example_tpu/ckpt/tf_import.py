@@ -1,0 +1,169 @@
+"""Import TensorFlow-era checkpoints — the reference's ``model.ckpt``.
+
+The reference's Saver wrote graph-variable checkpoints
+(``model.ckpt-N.{index,data-*}`` + a ``checkpoint`` state file, SURVEY.md
+§3.4). A user migrating from the reference has those files; this module
+reads them into this framework's param pytrees so training resumes (or
+evaluation runs) from the PS-era weights.
+
+TensorFlow is an OPTIONAL dependency here, exactly like
+``utils/trace_summary.py``: the framework never imports TF on its training
+path; this offline migration tool degrades with a clear error when the
+wheel is absent. Only the checkpoint *reader* is used — no graph, no
+session.
+
+Usage::
+
+    from distributed_tensorflow_example_tpu.ckpt import tf_import
+    arrays = tf_import.load_tf_checkpoint("/old/run/model.ckpt-2000")
+    params = tf_import.import_into(
+        template_params, arrays, mapping=tf_import.mnist_mlp_mapping(arrays))
+
+``mapping`` is ``{pytree-path: tf-variable-name}`` with ``/``-joined
+pytree paths (the same path syntax the npz checkpoints use).
+:func:`mnist_mlp_mapping` auto-detects the two variable-naming styles the
+reference genre used for the 2-layer MNIST MLP.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import numpy as np
+
+from ..utils.pytree import path_str as _path_str
+
+PyTree = object
+
+
+def load_tf_checkpoint(prefix: str) -> dict[str, np.ndarray]:
+    """Read every variable of a TF checkpoint into host arrays.
+
+    ``prefix`` is the checkpoint prefix (``.../model.ckpt-2000``, i.e. the
+    path without ``.index``/``.data-*`` suffix), or a directory containing
+    a ``checkpoint`` state file (the latest checkpoint is used).
+    """
+    try:
+        import tensorflow as tf
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "importing TF checkpoints needs the tensorflow wheel "
+            "(offline migration tool only; the framework itself does not "
+            "depend on TF)") from e
+    import os
+    if os.path.isdir(prefix):
+        latest = tf.train.latest_checkpoint(prefix)
+        if latest is None:
+            raise FileNotFoundError(
+                f"no TF checkpoint state under {prefix!r}")
+        prefix = latest
+    reader = tf.train.load_checkpoint(prefix)
+    shapes = reader.get_variable_to_shape_map()
+    return {name: np.asarray(reader.get_tensor(name))
+            for name in shapes
+            # bookkeeping tensors, not model variables
+            if not name.startswith("_CHECKPOINTABLE_OBJECT_GRAPH")}
+
+
+def import_into(template: PyTree, arrays: Mapping[str, np.ndarray],
+                mapping: Mapping[str, str], *,
+                allow_missing: bool = False) -> PyTree:
+    """Place TF variables into a param pytree per ``mapping``.
+
+    Every mapped leaf is shape-checked against the template; unmapped
+    template leaves keep their template values (fresh init) — so a
+    partial import (e.g. backbone only) is explicit in the mapping, and
+    ``allow_missing=False`` (default) raises if a mapped TF name is
+    absent from ``arrays``.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    matched: set[str] = set()
+    for path, tleaf in flat:
+        key = _path_str(path)
+        tf_name = mapping.get(key)
+        if tf_name is None:
+            leaves.append(tleaf)
+            continue
+        matched.add(key)
+        if tf_name not in arrays:
+            if allow_missing:
+                leaves.append(tleaf)
+                continue
+            raise KeyError(
+                f"mapping sends {key!r} to TF variable {tf_name!r}, which "
+                f"the checkpoint does not contain (has: "
+                f"{sorted(arrays)[:8]}...)")
+        arr = np.asarray(arrays[tf_name])
+        tshape = tuple(getattr(tleaf, "shape", arr.shape))
+        if tuple(arr.shape) != tshape:
+            raise ValueError(
+                f"TF variable {tf_name!r} shape {arr.shape} != template "
+                f"leaf {key!r} shape {tshape}")
+        if hasattr(tleaf, "dtype"):
+            arr = arr.astype(tleaf.dtype, copy=False)
+        if isinstance(tleaf, jax.Array):
+            leaves.append(jax.device_put(arr, tleaf.sharding))
+        else:
+            leaves.append(jax.numpy.asarray(arr))
+    unconsumed = set(mapping) - matched
+    if unconsumed:
+        # a mapping key that matches NO template path would otherwise
+        # silently leave fresh-init weights in place — the
+        # trained-from-random failure a migration tool must never allow
+        raise KeyError(
+            f"mapping keys {sorted(unconsumed)} match no path in the "
+            f"template pytree (template paths are '/'-joined, e.g. "
+            f"'fc1/kernel'; pass the PARAMS pytree, not a TrainState)")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def mnist_mlp_mapping(arrays: Mapping[str, np.ndarray]
+                      ) -> dict[str, str]:
+    """Mapping for the reference's 2-layer MNIST MLP (SURVEY.md §2.1).
+
+    The example genre used two naming styles:
+
+    - anonymous ``tf.Variable``s: ``Variable`` (W1), ``Variable_1`` (b1),
+      ``Variable_2`` (W2), ``Variable_3`` (b2);
+    - scoped ``hid_w/sm_w``-style names (the canonical blog example):
+      weights named ``*hid_w*``/``*sm_w*``, biases ``*hid_b*``/``*sm_b*``.
+
+    Detection is by name first, falling back to rank/shape order (two
+    rank-2 weights sorted by fan-in, their matching rank-1 biases).
+    """
+    names = sorted(arrays)
+
+    def find(*subs):
+        for n in names:
+            if any(s in n for s in subs):
+                return n
+        return None
+
+    w1 = find("hid_w", "h1/weights", "fc1/kernel", "dense/kernel")
+    b1 = find("hid_b", "h1/biases", "fc1/bias", "dense/bias")
+    w2 = find("sm_w", "out/weights", "fc2/kernel", "dense_1/kernel")
+    b2 = find("sm_b", "out/biases", "fc2/bias", "dense_1/bias")
+    if not all((w1, b1, w2, b2)):
+        # anonymous-Variable style: identify layers by the chained dims
+        # (w1's output dim is w2's input dim) — robust for any width,
+        # unlike fan-in ordering which breaks when hidden > in_dim
+        ws = [n for n in names if arrays[n].ndim == 2]
+        bs = [n for n in names if arrays[n].ndim == 1]
+        if len(ws) == 2 and len(bs) == 2:
+            a, b = ws
+            if arrays[a].shape[1] == arrays[b].shape[0]:
+                w1, w2 = a, b
+            elif arrays[b].shape[1] == arrays[a].shape[0]:
+                w1, w2 = b, a
+            if w1 is not None:
+                # bias dims match the weights' output dims
+                bs.sort(key=lambda n: (arrays[n].shape[0]
+                                       != arrays[w1].shape[1]))
+                b1, b2 = bs
+    if not all((w1, b1, w2, b2)):
+        raise ValueError(
+            f"cannot identify the 2-layer MLP variables among {names}")
+    return {"fc1/kernel": w1, "fc1/bias": b1,
+            "fc2/kernel": w2, "fc2/bias": b2}

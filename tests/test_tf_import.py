@@ -1,0 +1,152 @@
+"""TF checkpoint migration (ckpt/tf_import.py): the reference's Saver
+checkpoints (SURVEY.md §3.4) import into this framework's param pytrees.
+TF is used here as the producer oracle — exactly the role it plays for a
+user migrating a real PS-era run.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_example_tpu.ckpt import tf_import
+from distributed_tensorflow_example_tpu.models.mlp import MLP
+
+
+@pytest.fixture(scope="module")
+def reference_ckpt(tmp_path_factory):
+    """A v1-style checkpoint in the canonical blog example's layout:
+    hid_w/hid_b (784x100) + sm_w/sm_b (100x10)."""
+    d = tmp_path_factory.mktemp("tfckpt")
+    rs = np.random.RandomState(0)
+    vals = {
+        "hid_w": rs.randn(784, 100).astype(np.float32) * 0.05,
+        "hid_b": rs.randn(100).astype(np.float32) * 0.01,
+        "sm_w": rs.randn(100, 10).astype(np.float32) * 0.05,
+        "sm_b": rs.randn(10).astype(np.float32) * 0.01,
+    }
+    v1 = tf.compat.v1
+    g = v1.Graph()
+    with g.as_default():
+        tfvars = {k: v1.Variable(v, name=k) for k, v in vals.items()}
+        saver = v1.train.Saver()
+        with v1.Session() as sess:
+            sess.run(v1.global_variables_initializer())
+            prefix = saver.save(sess, str(d / "model.ckpt"),
+                                global_step=2000)
+    return prefix, str(d), vals
+
+
+def test_load_tf_checkpoint_by_prefix_and_dir(reference_ckpt):
+    prefix, ckpt_dir, vals = reference_ckpt
+    for src in (prefix, ckpt_dir):
+        arrays = tf_import.load_tf_checkpoint(src)
+        for k, v in vals.items():
+            np.testing.assert_array_equal(arrays[k], v)
+
+
+def test_import_into_mlp_and_forward_parity(reference_ckpt):
+    prefix, _, vals = reference_ckpt
+    arrays = tf_import.load_tf_checkpoint(prefix)
+    model = MLP(in_dim=784, hidden=100, num_classes=10)
+    template = model.init(jax.random.PRNGKey(0))
+    mapping = tf_import.mnist_mlp_mapping(arrays)
+    params = tf_import.import_into(template, arrays, mapping)
+
+    np.testing.assert_array_equal(params["fc1"]["kernel"], vals["hid_w"])
+    np.testing.assert_array_equal(params["fc2"]["bias"], vals["sm_b"])
+
+    # forward pass must equal the reference graph's math (numpy oracle)
+    x = np.random.RandomState(1).rand(4, 784).astype(np.float32)
+    logits, _ = model.apply(params, {}, {"x": jnp.asarray(x)})
+    h = np.maximum(x @ vals["hid_w"] + vals["hid_b"], 0.0)
+    want = h @ vals["sm_w"] + vals["sm_b"]
+    np.testing.assert_allclose(np.asarray(logits), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_anonymous_variable_style_mapping(tmp_path):
+    """Forks using bare tf.Variable (Variable, Variable_1, ...) map by
+    rank/shape order."""
+    rs = np.random.RandomState(2)
+    vals = [rs.randn(784, 64).astype(np.float32),
+            rs.randn(64).astype(np.float32),
+            rs.randn(64, 10).astype(np.float32),
+            rs.randn(10).astype(np.float32)]
+    v1 = tf.compat.v1
+    g = v1.Graph()
+    with g.as_default():
+        for v in vals:
+            v1.Variable(v)                      # anonymous
+        saver = v1.train.Saver()
+        with v1.Session() as sess:
+            sess.run(v1.global_variables_initializer())
+            prefix = saver.save(sess, str(tmp_path / "model.ckpt"))
+    arrays = tf_import.load_tf_checkpoint(prefix)
+    mapping = tf_import.mnist_mlp_mapping(arrays)
+    model = MLP(in_dim=784, hidden=64, num_classes=10)
+    params = tf_import.import_into(model.init(jax.random.PRNGKey(0)),
+                                   arrays, mapping)
+    np.testing.assert_array_equal(params["fc1"]["kernel"], vals[0])
+    np.testing.assert_array_equal(params["fc1"]["bias"], vals[1])
+    np.testing.assert_array_equal(params["fc2"]["kernel"], vals[2])
+    np.testing.assert_array_equal(params["fc2"]["bias"], vals[3])
+
+
+def test_anonymous_style_with_hidden_wider_than_input(tmp_path):
+    """Layer pairing keys on chained dims (w1 out == w2 in), so a
+    64->1024->10 net maps correctly even though hidden > in_dim."""
+    rs = np.random.RandomState(3)
+    vals = [rs.randn(64, 1024).astype(np.float32),
+            rs.randn(1024).astype(np.float32),
+            rs.randn(1024, 10).astype(np.float32),
+            rs.randn(10).astype(np.float32)]
+    v1 = tf.compat.v1
+    g = v1.Graph()
+    with g.as_default():
+        for v in vals:
+            v1.Variable(v)
+        saver = v1.train.Saver()
+        with v1.Session() as sess:
+            sess.run(v1.global_variables_initializer())
+            prefix = saver.save(sess, str(tmp_path / "model.ckpt"))
+    arrays = tf_import.load_tf_checkpoint(prefix)
+    mapping = tf_import.mnist_mlp_mapping(arrays)
+    model = MLP(in_dim=64, hidden=1024, num_classes=10)
+    params = tf_import.import_into(model.init(jax.random.PRNGKey(0)),
+                                   arrays, mapping)
+    np.testing.assert_array_equal(params["fc1"]["kernel"], vals[0])
+    np.testing.assert_array_equal(params["fc2"]["kernel"], vals[2])
+
+
+def test_unmatched_mapping_key_raises(reference_ckpt):
+    """A mapping key matching no template path must hard-error — the
+    silent alternative is training from random init while believing the
+    checkpoint was imported."""
+    prefix, _, _ = reference_ckpt
+    arrays = tf_import.load_tf_checkpoint(prefix)
+    model = MLP(in_dim=784, hidden=100, num_classes=10)
+    template = model.init(jax.random.PRNGKey(0))
+    bad = {"params/fc1/kernel": "hid_w"}     # TrainState-style prefix
+    with pytest.raises(KeyError, match="match no path"):
+        tf_import.import_into(template, arrays, bad)
+
+
+def test_shape_mismatch_and_missing_raise(reference_ckpt):
+    prefix, _, _ = reference_ckpt
+    arrays = tf_import.load_tf_checkpoint(prefix)
+    model = MLP(in_dim=784, hidden=50, num_classes=10)   # wrong hidden
+    template = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="shape"):
+        tf_import.import_into(template, arrays,
+                              tf_import.mnist_mlp_mapping(arrays))
+    with pytest.raises(KeyError, match="does not contain"):
+        tf_import.import_into(template, arrays, {"fc1/kernel": "nope"})
+    # allow_missing keeps the template leaf
+    out = tf_import.import_into(template, arrays, {"fc1/kernel": "nope"},
+                                allow_missing=True)
+    np.testing.assert_array_equal(out["fc1"]["kernel"],
+                                  template["fc1"]["kernel"])
