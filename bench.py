@@ -75,7 +75,7 @@ def _step_flops(compiled) -> float | None:
 
 def _run(model_name: str, *, batch: int, steps: int, warmup: int,
          opt: OptimizerConfig, make_batch, extra_cfg: dict | None = None,
-         steps_per_call: int = 1):
+         steps_per_call: int = 1, prng_impl: str | None = None):
     """Time `steps` sync steps; returns (examples/sec/chip, step_ms, mfu).
 
     ``steps_per_call > 1`` uses the device-side multi-step loop
@@ -92,7 +92,7 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     model = get_model(model_name, cfg)
     tx = make_optimizer(cfg.optimizer)
     sync = SyncReplicas(model.loss, tx, mesh)
-    state = sync.init(model.init, seed=0)
+    state = sync.init(model.init, seed=0, prng_impl=prng_impl)
 
     k = steps_per_call
     if k > 1:
@@ -182,11 +182,14 @@ def main() -> None:
         # 0.410 @ 128 → 0.383 @ 256): Adam's ~10 ms of weight traffic is
         # batch-independent, so bigger global batch amortizes it until
         # attention score tensors start spilling
+        # rbg = the TPU-native RNG (--prng_impl rbg): dropout-mask
+        # generation dominates threefry's TPU cost — measured 112.4 ->
+        # 89.1 ms/step on this exact config (BASELINE.md round 3)
         eps, ms, mfu = _run(
             "bert", batch=max(8, 128 // scale),
             steps=20 if on_tpu else 2, warmup=5 if on_tpu else 1,
             opt=OptimizerConfig(name="adamw", learning_rate=1e-4),
-            make_batch=_dummy_batch)
+            make_batch=_dummy_batch, prng_impl="rbg" if on_tpu else None)
         extra["bert_base_eps_chip"] = round(eps, 1)
         extra["bert_base_step_ms"] = round(ms, 2)
         if mfu:

@@ -75,6 +75,11 @@ def _flatten(state: PyTree) -> dict[str, np.ndarray]:
         key = _path_str(path)
         if _is_key(leaf):
             out["__prngkey__/" + key] = np.asarray(jax.random.key_data(leaf))
+            # the key impl (threefry2x32 / rbg) must survive the round
+            # trip: wrap_key_data under the wrong impl mis-sizes or
+            # silently changes the random stream
+            out["__prngimpl__/" + key] = np.frombuffer(
+                str(jax.random.key_impl(leaf)).encode(), dtype=np.uint8)
         else:
             arr = _to_host(leaf)
             if arr.dtype == ml_dtypes.bfloat16:
@@ -129,6 +134,7 @@ def _flatten_local(state: PyTree) -> tuple[dict[str, np.ndarray], dict]:
                 pk = _piece_key(key, (0,) * arr.ndim)
                 pieces[pk] = arr
                 meta[key] = {"kind": "prngkey", "dtype": str(arr.dtype),
+                             "impl": str(jax.random.key_impl(leaf)),
                              "shape": list(arr.shape),
                              "pieces": [{"key": pk,
                                          "start": [0] * arr.ndim,
@@ -219,8 +225,11 @@ def _unflatten(template: PyTree, arrays: dict[str, np.ndarray]) -> PyTree:
     for path, tleaf in paths_and_leaves:
         key = _path_str(path)
         if "__prngkey__/" + key in arrays:
+            impl_raw = arrays.get("__prngimpl__/" + key)
+            kw = ({"impl": bytes(impl_raw).decode()}
+                  if impl_raw is not None else {})   # pre-impl ckpts
             leaves.append(jax.random.wrap_key_data(
-                np.asarray(arrays["__prngkey__/" + key])))
+                np.asarray(arrays["__prngkey__/" + key]), **kw))
             continue
         if "__bf16__/" + key in arrays:
             leaf = arrays["__bf16__/" + key].view(ml_dtypes.bfloat16)
@@ -545,8 +554,10 @@ class CheckpointManager:
                 if entry is None:
                     raise KeyError(f"sharded checkpoint missing leaf {key!r}")
                 if entry["kind"] == "prngkey":
+                    kw = ({"impl": entry["impl"]} if "impl" in entry
+                          else {})                   # pre-impl ckpts
                     leaves.append(jax.random.wrap_key_data(
-                        np.asarray(_leaf_from_pieces(entry, loads))))
+                        np.asarray(_leaf_from_pieces(entry, loads)), **kw))
                     continue
                 if tuple(entry["shape"]) != tuple(
                         getattr(tleaf, "shape", entry["shape"])):

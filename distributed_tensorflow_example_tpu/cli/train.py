@@ -94,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", default="xla", choices=["xla", "flash"],
                    help="attention implementation for transformer models "
                         "(flash = Pallas kernel, wins at long sequences)")
+    p.add_argument("--prng_impl", default="threefry2x32",
+                   choices=["threefry2x32", "rbg", "unsafe_rbg"],
+                   help="PRNG key implementation for the training rng "
+                        "stream; rbg uses the TPU's native generator "
+                        "(BERT-base measured 112.4->89.1 ms/step: dropout-"
+                        "mask generation dominates threefry's TPU cost)")
     p.add_argument("--remat", default="none",
                    choices=["none", "full", "dots"],
                    help="jax.checkpoint each transformer layer: backward "
@@ -182,6 +188,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         param_dtype=args.param_dtype,
         attention_impl=args.attention,
         remat=args.remat,
+        prng_impl=args.prng_impl,
         mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
         data=DataConfig(dataset=args.dataset or args.model,
                         data_dir=args.data_dir,

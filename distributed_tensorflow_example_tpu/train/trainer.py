@@ -156,7 +156,8 @@ class Trainer:
         """Restore-or-init (SessionManager.prepare_session parity)."""
         state, restored = restore_or_init(
             self.ckpt_manager,
-            lambda: self.sync.init(self.model.init, seed=self.config.seed))
+            lambda: self.sync.init(self.model.init, seed=self.config.seed,
+                                   prng_impl=self.config.prng_impl))
         self.state = state
         self.start_step = int(jax.device_get(state.step))
         if restored:

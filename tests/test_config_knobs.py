@@ -119,6 +119,39 @@ def test_default_moment_dtype_stays_f32_under_bf16_params():
         assert l.dtype == jnp.float32, l.dtype
 
 
+def test_prng_impl_rbg_threads_through_training_and_checkpoint(tmp_path):
+    """--prng_impl rbg: the key impl reaches the state rng, training
+    runs, and BOTH checkpoint formats restore the impl (wrap_key_data
+    under the wrong impl would mis-size or silently change the random
+    stream)."""
+    from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+        CheckpointManager)
+    m = get_model("bert_tiny", TrainConfig(model="bert_tiny"))
+    mesh = local_mesh(1)
+    tx = make_optimizer(OptimizerConfig(name="adamw", learning_rate=1e-3))
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init, seed=0, prng_impl="rbg")
+    assert str(jax.random.key_impl(state.rng)) == "rbg"
+    b = m.dummy_batch(4)
+    losses = []
+    for _ in range(3):
+        state, metr = sync.step(state, sync.shard_batch(b))
+        losses.append(float(metr["loss"]))
+    assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+
+    for sharded in (False, True):
+        mgr = CheckpointManager(str(tmp_path / f"s{sharded}"),
+                                sharded=sharded)
+        mgr.save(state, 1)
+        restored = mgr.restore(jax.tree_util.tree_map(lambda x: x, state),
+                               1)
+        assert str(jax.random.key_impl(restored.rng)) == "rbg"
+        # identical continuation: the stream must not fork on restore
+        np.testing.assert_array_equal(
+            jax.random.key_data(jax.random.fold_in(state.rng, 9)),
+            jax.random.key_data(jax.random.fold_in(restored.rng, 9)))
+
+
 def test_moment_dtype_rejects_garbage():
     with pytest.raises(ValueError, match="moment_dtype"):
         make_optimizer(OptimizerConfig(name="adam",
