@@ -71,7 +71,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup_steps", type=int, default=0,
                    help="linear LR warmup steps")
     p.add_argument("--decay_schedule", default="constant",
-                   choices=["constant", "cosine", "linear"])
+                   choices=["constant", "cosine", "linear", "piecewise"])
+    p.add_argument("--decay_boundaries", default="",
+                   help="comma-separated steps where piecewise LR drops "
+                        "(e.g. '30000,60000,80000')")
+    p.add_argument("--decay_factor", type=float, default=0.1,
+                   help="piecewise LR multiplier at each boundary")
+    p.add_argument("--label_smoothing", type=float, default=0.0,
+                   help="smooth training targets (image classifiers: "
+                        "lenet/resnet20/resnet50; the standard ImageNet "
+                        "recipe uses 0.1)")
     p.add_argument("--grad_clip_norm", type=float, default=0.0,
                    help="global-norm gradient clipping (0 disables)")
     p.add_argument("--moment_dtype", default="float32",
@@ -181,6 +190,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     return TrainConfig(
         model=args.model,
         train_steps=args.train_steps,
+        label_smoothing=args.label_smoothing,
         eval_every_steps=args.eval_every_steps,
         steps_per_loop=args.steps_per_loop,
         seed=args.seed,
@@ -202,6 +212,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                                   weight_decay=args.weight_decay,
                                   warmup_steps=args.warmup_steps,
                                   decay_schedule=args.decay_schedule,
+                                  decay_boundaries=tuple(
+                                      int(b) for b in
+                                      args.decay_boundaries.split(",")
+                                      if b.strip()),
+                                  decay_factor=args.decay_factor,
                                   grad_clip_norm=args.grad_clip_norm,
                                   moment_dtype=args.moment_dtype,
                                   total_steps=args.train_steps),
@@ -318,6 +333,12 @@ def main(argv: list[str] | None = None) -> int:
         # fail fast: everything below (dataset load, mesh, Trainer) can
         # take minutes for the big datasets
         raise SystemExit("--eval_only requires --ckpt_dir")
+    if args.label_smoothing and args.model not in ("lenet", "resnet20",
+                                                   "resnet50"):
+        # a silently ignored training knob is worse than an error
+        raise SystemExit(
+            f"--label_smoothing is wired for the image classifiers "
+            f"(lenet/resnet20/resnet50), not model {args.model!r}")
 
     cluster = None
     if args.ps_hosts or args.worker_hosts:

@@ -52,7 +52,9 @@ class OptimizerConfig:
     momentum: float = 0.9
     weight_decay: float = 0.0
     warmup_steps: int = 0
-    decay_schedule: str = "constant"  # constant | cosine | linear
+    decay_schedule: str = "constant"  # constant | cosine | linear | piecewise
+    decay_boundaries: tuple[int, ...] = ()  # piecewise: steps where LR drops
+    decay_factor: float = 0.1       # piecewise: multiplier at each boundary
     total_steps: int = 0            # for schedules; 0 => constant
     grad_clip_norm: float = 0.0     # 0 disables
     moment_dtype: str = "float32"   # float32 | bfloat16 — first-moment
@@ -155,6 +157,9 @@ class TrainConfig:
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     obs: ObservabilityConfig = dataclasses.field(default_factory=ObservabilityConfig)
     train_steps: int = 1000
+    label_smoothing: float = 0.0     # image classifiers (resnet20/50):
+                                     # smooth training targets; eval
+                                     # metrics stay unsmoothed
     eval_every_steps: int = 0        # 0 => eval only at the end
     steps_per_loop: int = 1          # steps per device dispatch (lax.scan
                                      # inner loop — TPU-era iterations_per_loop

@@ -24,11 +24,13 @@ class LeNet(DefaultRulesMixin):
     name = "lenet"
 
     def __init__(self, num_classes: int = 10, dropout_rate: float = 0.0,
-                 dtype=jnp.float32, param_dtype=jnp.float32):
+                 dtype=jnp.float32, param_dtype=jnp.float32,
+                 label_smoothing: float = 0.0):
         self.num_classes = num_classes
         self.dropout_rate = dropout_rate
         self.dtype = dtype
         self.param_dtype = param_dtype
+        self.label_smoothing = label_smoothing
 
     def init(self, rng: jax.Array):
         r = jax.random.split(rng, 4)
@@ -57,7 +59,8 @@ class LeNet(DefaultRulesMixin):
 
     def loss(self, params, extras, batch, rng):
         logits, new_extras = self.apply(params, extras, batch, rng, train=True)
-        loss = losses.softmax_xent_int_labels(logits, batch["y"])
+        loss = losses.softmax_xent_int_labels(
+            logits, batch["y"], label_smoothing=self.label_smoothing)
         aux = {"accuracy": losses.accuracy(logits, batch["y"])}
         return loss, (aux, new_extras)
 
@@ -77,4 +80,5 @@ class LeNet(DefaultRulesMixin):
 @register_model("lenet")
 def _make_lenet(config: TrainConfig) -> LeNet:
     return LeNet(dtype=resolve_dtype(config.dtype),
-                 param_dtype=resolve_dtype(config.param_dtype))
+                 param_dtype=resolve_dtype(config.param_dtype),
+                 label_smoothing=config.label_smoothing)

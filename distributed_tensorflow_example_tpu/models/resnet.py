@@ -126,7 +126,7 @@ class ResNet(DefaultRulesMixin):
     def __init__(self, name: str, block, stage_sizes: Sequence[int],
                  widths: Sequence[int], num_classes: int,
                  input_hw: int, imagenet_stem: bool, dtype=jnp.float32,
-                 param_dtype=jnp.float32):
+                 param_dtype=jnp.float32, label_smoothing: float = 0.0):
         self.name = name
         self.block = block
         self.stage_sizes = list(stage_sizes)
@@ -136,6 +136,9 @@ class ResNet(DefaultRulesMixin):
         self.imagenet_stem = imagenet_stem
         self.dtype = dtype
         self.param_dtype = param_dtype
+        # the standard ImageNet recipe smooths training targets (eval
+        # metrics stay unsmoothed — comparable across smoothing settings)
+        self.label_smoothing = label_smoothing
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array):
@@ -195,7 +198,8 @@ class ResNet(DefaultRulesMixin):
     # ------------------------------------------------------------------
     def loss(self, params, extras, batch, rng):
         logits, new_extras = self.apply(params, extras, batch, rng, train=True)
-        loss = losses.softmax_xent_int_labels(logits, batch["y"])
+        loss = losses.softmax_xent_int_labels(
+            logits, batch["y"], label_smoothing=self.label_smoothing)
         aux = {"accuracy": losses.accuracy(logits, batch["y"])}
         return loss, (aux, new_extras)
 
@@ -218,7 +222,8 @@ def _make_resnet20(config: TrainConfig) -> ResNet:
     return ResNet("resnet20", _BasicBlock, [3, 3, 3], [16, 32, 64],
                   num_classes=10, input_hw=32, imagenet_stem=False,
                   dtype=resolve_dtype(config.dtype),
-                  param_dtype=resolve_dtype(config.param_dtype))
+                  param_dtype=resolve_dtype(config.param_dtype),
+                  label_smoothing=config.label_smoothing)
 
 
 @register_model("resnet50")
@@ -226,4 +231,5 @@ def _make_resnet50(config: TrainConfig) -> ResNet:
     return ResNet("resnet50", _BottleneckBlock, [3, 4, 6, 3],
                   [64, 128, 256, 512], num_classes=1000, input_hw=224,
                   imagenet_stem=True, dtype=resolve_dtype(config.dtype),
-                  param_dtype=resolve_dtype(config.param_dtype))
+                  param_dtype=resolve_dtype(config.param_dtype),
+                  label_smoothing=config.label_smoothing)

@@ -24,12 +24,26 @@ def softmax_xent(logits: jax.Array, onehot: jax.Array,
 
 
 def softmax_xent_int_labels(logits: jax.Array, labels: jax.Array,
-                            *, where=None) -> jax.Array:
+                            *, where=None,
+                            label_smoothing: float = 0.0) -> jax.Array:
     """Mean softmax cross-entropy against integer labels (gather form —
-    avoids materializing one-hots for big vocabularies like BERT's)."""
+    avoids materializing one-hots for big vocabularies like BERT's).
+
+    ``label_smoothing=ε`` mixes the one-hot target with uniform mass:
+    target log-likelihood becomes ``(1-ε)·logit_y + ε·mean(logits) -
+    logz`` — algebraically identical to xent against the smoothed
+    distribution, still without materializing one-hots.
+    """
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}")
     logz = jax.nn.logsumexp(logits, axis=-1)
-    ll = jnp.take_along_axis(
-        logits, labels[..., None], axis=-1).squeeze(-1) - logz
+    picked = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1).squeeze(-1)
+    if label_smoothing:
+        eps = label_smoothing
+        picked = (1.0 - eps) * picked + eps * jnp.mean(logits, axis=-1)
+    ll = picked - logz
     if where is not None:
         return -jnp.sum(ll * where) / jnp.maximum(jnp.sum(where), 1.0)
     return -jnp.mean(ll)

@@ -33,7 +33,22 @@ def _moment_dtype(cfg: OptimizerConfig):
 
 def make_schedule(cfg: OptimizerConfig):
     base = cfg.learning_rate
-    if cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
+    if cfg.decay_schedule == "piecewise":
+        # the step-decay ImageNet recipe (drop at epoch 30/60/80 etc.)
+        if not cfg.decay_boundaries:
+            raise ValueError(
+                "decay_schedule='piecewise' needs decay_boundaries")
+        # boundaries are ABSOLUTE training steps: join_schedules feeds the
+        # post-warmup schedule (count - warmup_steps), so shift them here
+        # or every drop would land warmup_steps late
+        if any(int(b) <= cfg.warmup_steps for b in cfg.decay_boundaries):
+            raise ValueError(
+                f"decay_boundaries {cfg.decay_boundaries} must all lie "
+                f"after warmup_steps={cfg.warmup_steps}")
+        sched = optax.piecewise_constant_schedule(
+            base, {int(b) - cfg.warmup_steps: cfg.decay_factor
+                   for b in cfg.decay_boundaries})
+    elif cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
         sched = optax.constant_schedule(base)
     elif cfg.decay_schedule == "cosine":
         sched = optax.cosine_decay_schedule(base, cfg.total_steps)

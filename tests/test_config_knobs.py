@@ -152,6 +152,67 @@ def test_prng_impl_rbg_threads_through_training_and_checkpoint(tmp_path):
             jax.random.key_data(jax.random.fold_in(restored.rng, 9)))
 
 
+def test_label_smoothing_matches_smoothed_onehot_oracle():
+    """The gather-form smoothed xent must equal xent against the
+    explicitly smoothed one-hot distribution."""
+    from distributed_tensorflow_example_tpu.ops import losses
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(8, 10).astype(np.float32))
+    labels = jnp.asarray(rs.randint(0, 10, 8).astype(np.int32))
+    eps = 0.1
+    got = losses.softmax_xent_int_labels(logits, labels,
+                                         label_smoothing=eps)
+    onehot = jax.nn.one_hot(labels, 10)
+    smoothed = (1 - eps) * onehot + eps / 10.0
+    want = losses.softmax_xent(logits, smoothed)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+    # eps=0 equals plain one-hot xent (continuity at the boundary)
+    np.testing.assert_allclose(
+        float(losses.softmax_xent_int_labels(logits, labels)),
+        float(losses.softmax_xent(logits, onehot)), rtol=1e-6)
+    with pytest.raises(ValueError, match="label_smoothing"):
+        losses.softmax_xent_int_labels(logits, labels, label_smoothing=1.0)
+
+
+def test_label_smoothing_reaches_resnet():
+    cfg = TrainConfig(model="resnet20", label_smoothing=0.1)
+    m = get_model("resnet20", cfg)
+    assert m.label_smoothing == 0.1
+    # default off
+    assert get_model("resnet20",
+                     TrainConfig(model="resnet20")).label_smoothing == 0.0
+
+
+def test_piecewise_schedule():
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    sched = make_schedule(OptimizerConfig(
+        name="momentum", learning_rate=0.4, decay_schedule="piecewise",
+        decay_boundaries=(10, 20), decay_factor=0.1))
+    assert float(sched(0)) == pytest.approx(0.4)
+    assert float(sched(15)) == pytest.approx(0.04)
+    assert float(sched(25)) == pytest.approx(0.004)
+    with pytest.raises(ValueError, match="decay_boundaries"):
+        make_schedule(OptimizerConfig(decay_schedule="piecewise"))
+
+
+def test_piecewise_boundaries_are_absolute_under_warmup():
+    """join_schedules rebases the post-warmup schedule, so boundaries
+    must be shifted at construction — a drop at step 100 with 50 warmup
+    steps must land at 100, not 150."""
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    sched = make_schedule(OptimizerConfig(
+        name="momentum", learning_rate=0.4, decay_schedule="piecewise",
+        decay_boundaries=(100,), decay_factor=0.1, warmup_steps=50))
+    assert float(sched(99)) == pytest.approx(0.4)
+    assert float(sched(100)) == pytest.approx(0.04)
+    with pytest.raises(ValueError, match="warmup"):
+        make_schedule(OptimizerConfig(
+            decay_schedule="piecewise", decay_boundaries=(30,),
+            warmup_steps=50))
+
+
 def test_moment_dtype_rejects_garbage():
     with pytest.raises(ValueError, match="moment_dtype"):
         make_optimizer(OptimizerConfig(name="adam",
