@@ -63,6 +63,10 @@ class BertConfig:
         return cls()
 
     @classmethod
+    def large(cls) -> "BertConfig":
+        return cls(hidden=1024, layers=24, heads=16, intermediate=4096)
+
+    @classmethod
     def tiny(cls) -> "BertConfig":
         """2-layer test-size config (fast CPU compile)."""
         return cls(vocab_size=1000, hidden=128, layers=2, heads=4,
@@ -322,19 +326,29 @@ class Bert:
         }
 
 
-@register_model("bert")
-def _make_bert(config: TrainConfig) -> Bert:
-    cfg = BertConfig.base()
-    cfg.vocab_size = config.data.vocab_size
+def _make(config: TrainConfig, cfg: BertConfig, *,
+          config_vocab: bool = True) -> Bert:
+    """One factory for every size: knob threading lives in ONE place so
+    the registered variants can never diverge."""
+    if config_vocab:
+        cfg.vocab_size = config.data.vocab_size
     return Bert(cfg, dtype=resolve_dtype(config.dtype),
                 attention_impl=config.attention_impl,
                 param_dtype=resolve_dtype(config.param_dtype),
                 remat=config.remat)
 
 
+@register_model("bert")
+def _make_bert(config: TrainConfig) -> Bert:
+    return _make(config, BertConfig.base())
+
+
+@register_model("bert_large")
+def _make_bert_large(config: TrainConfig) -> Bert:
+    return _make(config, BertConfig.large())
+
+
 @register_model("bert_tiny")
 def _make_bert_tiny(config: TrainConfig) -> Bert:
-    return Bert(BertConfig.tiny(), dtype=resolve_dtype(config.dtype),
-                attention_impl=config.attention_impl,
-                param_dtype=resolve_dtype(config.param_dtype),
-                remat=config.remat)
+    # tiny keeps its own small vocab (fast CPU tests)
+    return _make(config, BertConfig.tiny(), config_vocab=False)

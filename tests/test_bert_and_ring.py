@@ -246,3 +246,14 @@ def test_ring_attention_gradient_parity_with_mask():
     for gr, gx in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gx),
                                    rtol=5e-4, atol=5e-5)
+
+
+def test_bert_large_registered():
+    """bert_large: BERT-large shape in the registry (24x1024x16; measured
+    59.7% MFU @ b64 on the v5e chip — BASELINE.md model-zoo row)."""
+    from distributed_tensorflow_example_tpu.config import TrainConfig
+    from distributed_tensorflow_example_tpu.models import get_model
+    m = get_model("bert_large", TrainConfig(model="bert_large"))
+    assert (m.cfg.hidden, m.cfg.layers, m.cfg.heads,
+            m.cfg.intermediate) == (1024, 24, 16, 4096)
+    assert m.cfg.vocab_size == 30522
