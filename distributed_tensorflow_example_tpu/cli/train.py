@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--streaming", action="store_true",
                    help="decode-per-batch streaming input pipeline "
                         "(bounded memory; ImageNet-scale folder trees)")
+    p.add_argument("--fast_decode", action="store_true",
+                   help="JPEG DCT-domain downscale decode for the "
+                        "streaming train split (~1.9x decode throughput; "
+                        "pixels deviate slightly from the plain decode)")
     p.add_argument("--augment", action="store_true",
                    help="training augmentation (train split only): "
                         "ImageNet random-resized crop + flip (requires "
@@ -206,7 +210,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                         batch_size=args.batch_size, seed=args.seed,
                         native=args.native, seq_len=args.seq_len,
                         max_per_class=args.max_per_class,
-                        streaming=args.streaming, augment=args.augment),
+                        streaming=args.streaming, augment=args.augment,
+                        fast_decode=args.fast_decode),
         optimizer=OptimizerConfig(name=args.optimizer,
                                   learning_rate=args.learning_rate,
                                   momentum=args.momentum,
@@ -260,6 +265,10 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
         raise SystemExit(
             f"--augment is an image-training recipe; dataset {name!r} "
             "has no augmentation pipeline")
+    if cfg.data.fast_decode and name not in IMAGENET_DATASETS:
+        raise SystemExit(
+            f"--fast_decode is a JPEG decode knob (streaming ImageNet); "
+            f"dataset {name!r} does not decode JPEGs")
     if eval_only and name in IMAGENET_DATASETS \
             and not cfg.data.synthetic and cfg.data.data_dir:
         from ..data.imagenet import load_imagenet_folder
@@ -286,16 +295,19 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
             train_src = StreamingSource(
                 cfg.data.data_dir, "train",
                 max_per_class=cfg.data.max_per_class,
-                augment=cfg.data.augment)
+                augment=cfg.data.augment,
+                fast_decode=cfg.data.fast_decode)
             v = load_imagenet_folder(cfg.data.data_dir, "val")
             return train_src, {"x": v["val_x"], "y": v["val_y"]}
-        if cfg.data.augment:
-            # eager arrays are decoded once: augmentation needs the
-            # per-epoch decode the streaming pipeline provides
-            raise SystemExit(
-                "--augment is not supported with --synthetic"
-                if cfg.data.synthetic or not cfg.data.data_dir
-                else "--augment requires --streaming")
+        for flag, on in (("--augment", cfg.data.augment),
+                         ("--fast_decode", cfg.data.fast_decode)):
+            if on:
+                # eager arrays are decoded once: both knobs act in the
+                # streaming pipeline's per-batch decode
+                raise SystemExit(
+                    f"{flag} is not supported with --synthetic"
+                    if cfg.data.synthetic or not cfg.data.data_dir
+                    else f"{flag} requires --streaming")
         from ..data.imagenet import get_imagenet
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)

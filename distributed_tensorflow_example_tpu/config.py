@@ -32,6 +32,10 @@ class DataConfig:
     streaming: bool = False         # decode-per-batch thread-pool pipeline
                                     # (data/streaming.py) instead of eager
                                     # whole-split decode — ImageNet scale
+    fast_decode: bool = False       # JPEG DCT-domain downscale decode
+                                    # (streaming train split; ~1.9x
+                                    # decode throughput, pixels deviate
+                                    # slightly from the plain decode)
     augment: bool = False           # training augmentation, train split
                                     # only: ImageNet random-resized crop +
                                     # flip (streaming path), CIFAR pad-4

@@ -53,7 +53,8 @@ class StreamingImageFolder:
                  process_index: int = 0, num_processes: int = 1,
                  shuffle: bool = True, seed: int = 0,
                  decode_threads: int = 8,
-                 augment: bool = False):
+                 augment: bool = False,
+                 fast_decode: bool = False):
         if global_batch % num_processes:
             raise ValueError(
                 f"global_batch {global_batch} not divisible by "
@@ -75,6 +76,7 @@ class StreamingImageFolder:
         self.shuffle = shuffle
         self.seed = seed
         self.augment = augment
+        self.fast_decode = fast_decode
         self.epoch = 0
         self._pool = ThreadPoolExecutor(max_workers=max(1, decode_threads))
 
@@ -89,10 +91,12 @@ class StreamingImageFolder:
             # bit-exactly on resume
             def one(i):
                 rng = np.random.default_rng([self.seed, epoch, int(i)])
-                return augment_image(self.paths[i], self.image_size, rng)
+                return augment_image(self.paths[i], self.image_size, rng,
+                                     fast=self.fast_decode)
         else:
             def one(i):
-                return decode_image(self.paths[i], self.image_size)
+                return decode_image(self.paths[i], self.image_size,
+                                    fast=self.fast_decode)
         xs = list(self._pool.map(one, indices))
         return {"x": np.stack(xs), "y": self.labels[indices]}
 
@@ -136,7 +140,7 @@ class StreamingSource:
     def __init__(self, data_dir: str, split: str = "train", *,
                  image_size: int = 224, max_per_class: int | None = None,
                  prefetch: int = 2, decode_threads: int = 8,
-                 augment: bool = False):
+                 augment: bool = False, fast_decode: bool = False):
         self.data_dir = data_dir
         self.split = split
         self.image_size = image_size
@@ -144,6 +148,7 @@ class StreamingSource:
         self.prefetch = prefetch
         self.decode_threads = decode_threads
         self.augment = augment
+        self.fast_decode = fast_decode
         self._folder: StreamingImageFolder | None = None
 
     def make_loader(self, global_batch: int, *, start_step: int = 0,
@@ -157,7 +162,7 @@ class StreamingSource:
             max_per_class=self.max_per_class, global_batch=global_batch,
             process_index=process_index, num_processes=num_processes,
             shuffle=shuffle, seed=seed, decode_threads=self.decode_threads,
-            augment=self.augment)
+            augment=self.augment, fast_decode=self.fast_decode)
         if start_step > 0:
             self._folder.skip(start_step)
         it = iter(self._folder)

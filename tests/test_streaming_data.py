@@ -183,3 +183,55 @@ def test_cli_augment_guards(image_tree):
         main(["--model=resnet50", "--augment", "--train_steps=1"])
     with pytest.raises(SystemExit, match="augmentation"):
         main(["--model=mlp", "--augment", "--train_steps=1"])
+
+
+def test_fast_decode_shapes_and_determinism(tmp_path):
+    """fast_decode (JPEG DCT-domain downscale): correct output shape,
+    deterministic, and actually a different pixel stream than plain
+    decode when the source is large enough for draft to engage."""
+    from PIL import Image
+
+    from distributed_tensorflow_example_tpu.data.imagenet import (
+        decode_image)
+    rs = np.random.RandomState(0)
+    root = tmp_path / "train" / "class_0"
+    root.mkdir(parents=True)
+    for i in range(8):
+        Image.fromarray(rs.randint(0, 255, (384, 512, 3),
+                                   dtype=np.uint8)).save(
+            root / f"i{i}.jpeg", quality=90)
+
+    p = str(root / "i0.jpeg")
+    a = decode_image(p, 64, fast=True)
+    b = decode_image(p, 64, fast=True)
+    plain = decode_image(p, 64)
+    assert a.shape == plain.shape == (64, 64, 3)
+    np.testing.assert_array_equal(a, b)            # deterministic
+    assert not np.array_equal(a, plain)            # draft engaged
+
+    kw = dict(image_size=64, global_batch=8, shuffle=False, seed=0,
+              fast_decode=True)
+    f1 = StreamingImageFolder(str(tmp_path), "train", **kw)
+    f2 = StreamingImageFolder(str(tmp_path), "train", **kw)
+    b1, b2 = next(f1.epoch_batches(0)), next(f2.epoch_batches(0))
+    np.testing.assert_array_equal(b1["x"], b2["x"])
+    f1.close(); f2.close()
+
+    # composes with augmentation (still deterministic)
+    fa = StreamingImageFolder(str(tmp_path), "train", image_size=64,
+                              global_batch=8, shuffle=False, seed=0,
+                              fast_decode=True, augment=True)
+    fb = StreamingImageFolder(str(tmp_path), "train", image_size=64,
+                              global_batch=8, shuffle=False, seed=0,
+                              fast_decode=True, augment=True)
+    np.testing.assert_array_equal(next(fa.epoch_batches(0))["x"],
+                                  next(fb.epoch_batches(0))["x"])
+    fa.close(); fb.close()
+
+
+def test_cli_fast_decode_guards():
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="synthetic"):
+        main(["--model=resnet50", "--fast_decode", "--train_steps=1"])
+    with pytest.raises(SystemExit, match="JPEG"):
+        main(["--model=mlp", "--fast_decode", "--train_steps=1"])
