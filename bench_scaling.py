@@ -32,8 +32,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="mlp")
     ap.add_argument("--per_replica_batch", type=int, default=1024)
-    ap.add_argument("--steps", type=int, default=30)
-    ap.add_argument("--warmup", type=int, default=5)
+    # Default None -> platform-resolved below: 300 on TPU (the MLP step
+    # is latency-bound through the tunnel; 30-step runs track dispatch
+    # jitter — observed 4.8-13.2 ms swings — not device throughput, the
+    # same methodology lesson as bench.py), 30 on the virtual CPU mesh
+    # (shape-validation only, and long oversubscribed 8-way collective
+    # runs can trip XLA:CPU's collective executor)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="measured steps (default: 300 on TPU, 30 on CPU)")
+    ap.add_argument("--warmup", type=int, default=None,
+                    help="warmup steps (default: 30 on TPU, 5 on CPU)")
     ap.add_argument("--cpu", action="store_true",
                     help="force an 8-device virtual CPU mesh")
     args = ap.parse_args()
@@ -60,6 +68,10 @@ def main() -> None:
 
     devices = jax.devices()
     platform = devices[0].platform
+    if args.steps is None:
+        args.steps = 300 if platform == "tpu" else 30
+    if args.warmup is None:
+        args.warmup = 30 if platform == "tpu" else 5
     sizes = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= len(devices)]
 
     for n in sizes:

@@ -1,4 +1,10 @@
-"""Checkpointing: Saver parity (SURVEY.md §3.4, §5.4)."""
+"""Checkpointing: Saver parity (SURVEY.md §3.4, §5.4).
+
+``tf_import`` (TF-era checkpoint migration) is a submodule, not a
+re-export: it carries an optional TensorFlow dependency that must not
+load on the training path —
+``from distributed_tensorflow_example_tpu.ckpt import tf_import``.
+"""
 
 from .checkpoint import CheckpointManager, latest_checkpoint, restore_or_init
 
