@@ -20,6 +20,7 @@ steps queue back-to-back on device with no host round-trip.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Iterator
 
@@ -194,6 +195,15 @@ class Trainer:
     def train(self) -> tuple[TrainState, dict[str, Any]]:
         if self.state is None:
             self.initialize()
+        # the full resolved config opens THIS run's segment of the
+        # metrics stream (the reference printed its flags at launch).
+        # The JSONL is append-mode across restarts, so consumers should
+        # take the LAST config record at or before a step record — each
+        # appended segment is self-describing, not just line 1
+        self.metrics_logger.log({
+            "config": dataclasses.asdict(self.config),
+            "num_processes": self.num_processes,
+            "start_step": self.start_step})
         state = self.state
         step = self.start_step
         stop = step >= self.config.train_steps

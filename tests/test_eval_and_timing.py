@@ -145,3 +145,32 @@ def test_step_timing_with_steps_per_loop(tmp_path):
     timing = [r for r in recs if "step_timing_ms" in r]
     assert timing
     assert timing[0]["step_timing_ms"]["steps_per_dispatch"] == 4
+
+
+def test_metrics_stream_opens_with_full_config(tmp_path):
+    """Each run SEGMENT of the metrics stream opens with the full
+    resolved TrainConfig (flag-print parity): the JSONL appends across
+    restarts, so a resumed run writes its own fresh config record."""
+    from distributed_tensorflow_example_tpu.cli.train import main
+    metrics = tmp_path / "m.jsonl"
+    base = ["--model=mlp", "--batch_size=64", "--prng_impl=rbg",
+            f"--metrics_path={metrics}", f"--ckpt_dir={tmp_path}/ckpt",
+            "--save_steps=10"]
+    rc = main(base + ["--train_steps=10", "--learning_rate=0.5"])
+    assert rc == 0
+    first = json.loads(metrics.read_text().splitlines()[0])
+    assert first["config"]["model"] == "mlp"
+    assert first["config"]["prng_impl"] == "rbg"
+    assert first["config"]["data"]["batch_size"] == 64
+    assert first["config"]["optimizer"]["learning_rate"] == 0.5
+    assert first["num_processes"] == 1 and first["start_step"] == 0
+
+    # resume with a changed flag: the appended segment opens with ITS
+    # config (consumers take the last config record before a step)
+    rc = main(base + ["--train_steps=20", "--learning_rate=0.1"])
+    assert rc == 0
+    configs = [json.loads(l) for l in metrics.read_text().splitlines()
+               if "config" in json.loads(l)]
+    assert len(configs) == 2
+    assert configs[1]["config"]["optimizer"]["learning_rate"] == 0.1
+    assert configs[1]["start_step"] == 10
