@@ -76,12 +76,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup_steps", type=int, default=0,
                    help="linear LR warmup steps")
     p.add_argument("--decay_schedule", default="constant",
-                   choices=["constant", "cosine", "linear", "piecewise"])
+                   choices=["constant", "cosine", "linear", "piecewise",
+                            "exponential"])
+    p.add_argument("--decay_steps", type=int, default=0,
+                   help="exponential: steps per decay_factor application "
+                        "(tf.train.exponential_decay parity)")
     p.add_argument("--decay_boundaries", default="",
                    help="comma-separated steps where piecewise LR drops "
                         "(e.g. '30000,60000,80000')")
     p.add_argument("--decay_factor", type=float, default=0.1,
-                   help="piecewise LR multiplier at each boundary")
+                   help="piecewise: LR multiplier at each boundary; "
+                        "exponential: decay rate per decay_steps")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="smooth training targets (image classifiers: "
                         "lenet/resnet20/resnet50; the standard ImageNet "
@@ -223,6 +228,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                                       args.decay_boundaries.split(",")
                                       if b.strip()),
                                   decay_factor=args.decay_factor,
+                                  decay_steps=args.decay_steps,
                                   grad_clip_norm=args.grad_clip_norm,
                                   moment_dtype=args.moment_dtype,
                                   total_steps=args.train_steps),

@@ -56,9 +56,14 @@ class OptimizerConfig:
     momentum: float = 0.9
     weight_decay: float = 0.0
     warmup_steps: int = 0
-    decay_schedule: str = "constant"  # constant | cosine | linear | piecewise
+    decay_schedule: str = "constant"  # constant | cosine | linear |
+                                      # piecewise | exponential
     decay_boundaries: tuple[int, ...] = ()  # piecewise: steps where LR drops
-    decay_factor: float = 0.1       # piecewise: multiplier at each boundary
+    decay_factor: float = 0.1       # piecewise: multiplier at each boundary;
+                                    # exponential: decay rate per decay_steps
+    decay_steps: int = 0            # exponential: steps per decay_factor
+                                    # application (tf.train.exponential_decay
+                                    # 'decay_steps'); staircase off
     total_steps: int = 0            # for schedules; 0 => constant
     grad_clip_norm: float = 0.0     # 0 disables
     moment_dtype: str = "float32"   # float32 | bfloat16 — first-moment

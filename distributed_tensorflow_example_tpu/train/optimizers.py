@@ -48,6 +48,21 @@ def make_schedule(cfg: OptimizerConfig):
         sched = optax.piecewise_constant_schedule(
             base, {int(b) - cfg.warmup_steps: cfg.decay_factor
                    for b in cfg.decay_boundaries})
+    elif cfg.decay_schedule == "exponential":
+        # tf.train.exponential_decay parity (the reference era's default
+        # schedule): lr * decay_factor^(step / decay_steps), continuous.
+        # ABSOLUTE steps, like piecewise: join_schedules rebases the
+        # post-warmup schedule, so pre-apply the decay the warmup period
+        # would have accrued — the curve then matches the tf formula at
+        # every absolute step >= warmup_steps
+        if cfg.decay_steps <= 0:
+            raise ValueError(
+                "decay_schedule='exponential' needs decay_steps > 0")
+        init = base * cfg.decay_factor ** (cfg.warmup_steps
+                                           / cfg.decay_steps)
+        sched = optax.exponential_decay(init,
+                                        transition_steps=cfg.decay_steps,
+                                        decay_rate=cfg.decay_factor)
     elif cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
         sched = optax.constant_schedule(base)
     elif cfg.decay_schedule == "cosine":

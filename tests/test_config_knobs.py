@@ -213,6 +213,29 @@ def test_piecewise_boundaries_are_absolute_under_warmup():
             warmup_steps=50))
 
 
+def test_exponential_schedule():
+    """tf.train.exponential_decay parity: lr * rate^(step/decay_steps),
+    continuous (staircase off)."""
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    sched = make_schedule(OptimizerConfig(
+        learning_rate=0.8, decay_schedule="exponential",
+        decay_steps=100, decay_factor=0.5))
+    assert float(sched(0)) == pytest.approx(0.8)
+    assert float(sched(100)) == pytest.approx(0.4)
+    assert float(sched(200)) == pytest.approx(0.2)
+    assert float(sched(50)) == pytest.approx(0.8 * 0.5 ** 0.5, rel=1e-5)
+    with pytest.raises(ValueError, match="decay_steps"):
+        make_schedule(OptimizerConfig(decay_schedule="exponential"))
+    # absolute-step contract under warmup (same rule as piecewise): at
+    # absolute step 200 with warmup 100, the tf formula gives rate^2
+    warm = make_schedule(OptimizerConfig(
+        learning_rate=0.8, decay_schedule="exponential",
+        decay_steps=100, decay_factor=0.5, warmup_steps=100))
+    assert float(warm(200)) == pytest.approx(0.2, rel=1e-5)
+    assert float(warm(100)) == pytest.approx(0.4, rel=1e-5)
+
+
 def test_moment_dtype_rejects_garbage():
     with pytest.raises(ValueError, match="moment_dtype"):
         make_optimizer(OptimizerConfig(name="adam",
