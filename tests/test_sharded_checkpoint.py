@@ -200,3 +200,60 @@ def test_resharding_restore_onto_different_mesh(tmp_path):
         if isinstance(t, jax.Array) and not jax.dtypes.issubdtype(
                 t.dtype, jax.dtypes.prng_key):
             assert r.sharding == t.sharding, path
+
+
+def test_sharded_async_single_process(sync_and_state, tmp_path):
+    """sharded+async is allowed single-process (no commit barrier needed):
+    save returns immediately, wait() lands the write, restore sees it."""
+    sync, state = sync_and_state
+    mgr = CheckpointManager(str(tmp_path), sharded=True, async_save=True)
+    mgr.save(state, 4)
+    mgr.wait()
+    assert os.path.exists(str(tmp_path / "ckpt-4.shards.json"))
+    restored = mgr.restore(jax.tree_util.tree_map(lambda x: x, state), 4)
+    _assert_states_equal(state, restored)
+    mgr.close()
+
+
+def test_sharded_roundtrip_randomized_pytrees(tmp_path):
+    """Randomized structures: nested dicts/lists, f32/bf16/int leaves,
+    scalars, odd host-local shapes — every leaf must survive the
+    piece-wise roundtrip bit-exactly. (Uneven pieces cannot arise:
+    jax.device_put rejects NamedShardings whose dim is not divisible by
+    the mesh, so every distributed piece is equal-sized by construction —
+    verified by attempting a (30, 3) placement over data=8.)"""
+    from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+    from distributed_tensorflow_example_tpu.parallel.sharding import (
+        batch_sharding)
+
+    mesh = local_mesh(8, {"data": 8})
+    rs = np.random.RandomState(0)
+    for trial in range(3):
+        tree = {
+            "a": jnp.asarray(rs.randn(16, 24).astype(np.float32)),
+            "nested": {
+                "b16": jnp.asarray(rs.randn(8, 8).astype(np.float32),
+                                   dtype=jnp.bfloat16),
+                "ints": jnp.asarray(rs.randint(0, 9, (7,)),
+                                    dtype=jnp.int32),
+                "list": [jnp.float32(1.5), jnp.int32(trial)],
+            },
+            "sharded": jax.device_put(
+                rs.randn(32, 5).astype(np.float32),
+                batch_sharding(mesh)),
+            "scalar": jnp.float32(rs.randn()),
+        }
+        if trial == 0:
+            with pytest.raises(ValueError, match="divisible"):
+                jax.device_put(rs.randn(30, 3).astype(np.float32),
+                               batch_sharding(mesh))
+        d = tmp_path / f"t{trial}"
+        mgr = CheckpointManager(str(d), sharded=True)
+        mgr.save(tree, trial)
+        restored = mgr.restore(jax.tree_util.tree_map(lambda x: x, tree),
+                               trial)
+        for (p, x), (_, y) in zip(
+                jax.tree_util.tree_flatten_with_path(tree)[0],
+                jax.tree_util.tree_flatten_with_path(restored)[0]):
+            assert x.dtype == y.dtype, p
+            assert jnp.array_equal(x, y), p
