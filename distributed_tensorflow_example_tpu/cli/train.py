@@ -15,6 +15,7 @@ reference's per-role launch scripts keep working (SURVEY.md §7 item 3).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from ..cluster import ClusterSpec, WORKER_JOB
@@ -255,6 +256,15 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     )
 
 
+def bert_vocab_file(data_dir: str | None) -> str | None:
+    """Path of the corpus vocab.txt when ``data_dir`` is a raw-text BERT
+    corpus (the text-pipeline trigger), else None."""
+    if not data_dir:
+        return None
+    p = os.path.join(data_dir, "vocab.txt")
+    return p if os.path.exists(p) else None
+
+
 def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
     """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts.
 
@@ -332,6 +342,32 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
             raise SystemExit(
                 f"--seq_len {seq_len} exceeds the model's max_len "
                 f"{bert_cfg.max_len}")
+        vocab_txt = bert_vocab_file(cfg.data.data_dir)
+        has_npy = cfg.data.data_dir and any(
+            os.path.exists(os.path.join(cfg.data.data_dir, f))
+            for f in ("train.npy", "tokens.npy"))
+        if vocab_txt and not has_npy and not cfg.data.synthetic:
+            # raw-text corpus + local vocab.txt: tokenize + pack + mask.
+            # Pre-tokenized .npy files take precedence when both exist
+            # (the vocab likely produced them) — no silent path switch.
+            # Cheap pre-check BEFORE tokenizing a possibly huge corpus:
+            # the model's embedding table must cover every token id.
+            with open(vocab_txt) as f:
+                n_vocab = sum(1 for _ in f)
+            if n_vocab > vocab:
+                raise SystemExit(
+                    f"vocab.txt has {n_vocab} tokens but the model's "
+                    f"vocab_size is {vocab} (ids beyond the embedding "
+                    "table clamp silently under jit). Pass --vocab_size "
+                    f"{n_vocab} for bert/bert_large/moe_bert; the *_tiny "
+                    "variants pin their own small vocab — shrink the "
+                    "vocab or use a full-size model")
+            from ..data.bert_text import get_bert_text_data
+            tr, te, data_vocab = get_bert_text_data(
+                cfg.data.data_dir, vocab_txt, seq_len=seq_len,
+                max_predictions=max_pred,
+                mask_prob=cfg.data.mlm_mask_prob, seed=cfg.data.seed)
+            return tr, te
         tr, te = get_bert_data(cfg.data.data_dir, vocab_size=vocab,
                                seq_len=seq_len, max_predictions=max_pred,
                                mask_prob=cfg.data.mlm_mask_prob,

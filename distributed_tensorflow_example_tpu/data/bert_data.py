@@ -60,8 +60,19 @@ def synthetic_corpus(num_seqs: int = 2048, seq_len: int = 128,
 
 def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
                       max_predictions: int = 20, mask_prob: float = 0.15,
-                      seed: int = 0) -> dict[str, np.ndarray]:
-    """Canonical BERT masking → static-shape batch arrays."""
+                      seed: int = 0,
+                      specials: tuple[int, ...] | None = None,
+                      pad: int = PAD, mask: int = MASK,
+                      first_regular: int = _FIRST_REGULAR
+                      ) -> dict[str, np.ndarray]:
+    """Canonical BERT masking → static-shape batch arrays.
+
+    Defaults follow the bert-base-uncased id convention; a custom vocab
+    (data/bert_text.py) passes its own ``specials``/``pad``/``mask`` and
+    ``first_regular`` (the lowest id random-replacement tokens may use).
+    """
+    if specials is None:
+        specials = _SPECIALS
     rs = np.random.RandomState(seed)
     n, s = seqs.shape
     m = max_predictions
@@ -69,7 +80,7 @@ def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
     # fully vectorized (a per-row Python loop is a minutes-long startup
     # wall at pretraining scale): draw a random key per position, push
     # non-maskable positions to the back, take each row's first k sorted
-    maskable = ~np.isin(seqs, _SPECIALS)
+    maskable = ~np.isin(seqs, specials)
     cand_counts = maskable.sum(axis=1)
     k = np.minimum.reduce([
         np.full(n, m),
@@ -87,8 +98,8 @@ def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
     weights = sel.astype(np.float32)
 
     decide = rs.rand(n, m)
-    rand_tok = rs.randint(_FIRST_REGULAR, vocab_size, size=(n, m))
-    new_tok = np.where(decide < 0.8, MASK,
+    rand_tok = rs.randint(first_regular, vocab_size, size=(n, m))
+    new_tok = np.where(decide < 0.8, mask,
                        np.where(decide < 0.9, rand_tok, orig)).astype(np.int32)
     input_ids = seqs.copy()
     rows = np.broadcast_to(np.arange(n)[:, None], (n, m))[sel]
@@ -97,7 +108,7 @@ def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
     return {
         "input_ids": input_ids.astype(np.int32),
         "token_type_ids": np.zeros((n, s), np.int32),
-        "attention_mask": (seqs != PAD).astype(np.int32),
+        "attention_mask": (seqs != pad).astype(np.int32),
         "masked_positions": positions,
         "masked_labels": labels,
         "masked_weights": weights,
