@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", default="sgd")
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--wd_mask", default="exclude_1d",
+                   choices=["exclude_1d", "all"],
+                   help="weight-decay mask: exclude_1d (standard; biases "
+                        "and LayerNorm scales undecayed) or all")
     p.add_argument("--warmup_steps", type=int, default=0,
                    help="linear LR warmup steps")
     p.add_argument("--decay_schedule", default="constant",
@@ -222,6 +226,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                                   learning_rate=args.learning_rate,
                                   momentum=args.momentum,
                                   weight_decay=args.weight_decay,
+                                  wd_mask=args.wd_mask,
                                   warmup_steps=args.warmup_steps,
                                   decay_schedule=args.decay_schedule,
                                   decay_boundaries=tuple(

@@ -55,6 +55,13 @@ class OptimizerConfig:
     learning_rate: float = 0.5
     momentum: float = 0.9
     weight_decay: float = 0.0
+    wd_mask: str = "exclude_1d"     # exclude_1d (standard: no decay on
+                                    # biases/LayerNorm scales — any leaf
+                                    # with ndim<=1) | all. NOTE: the
+                                    # default changed to exclude_1d in
+                                    # round 3; pass "all" to reproduce
+                                    # older decay-everything runs (no
+                                    # recorded artifact used nonzero wd)
     warmup_steps: int = 0
     decay_schedule: str = "constant"  # constant | cosine | linear |
                                       # piecewise | exponential

@@ -17,6 +17,7 @@ is why this knob never touches it.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -77,6 +78,21 @@ def make_schedule(cfg: OptimizerConfig):
     return sched
 
 
+def _wd_mask(cfg: OptimizerConfig):
+    """Decay mask per ``wd_mask``: the standard recipe decays only
+    matrices/embeddings (ndim >= 2); biases and LayerNorm scales are
+    regularized toward zero by decay, which hurts — every major BERT/
+    ViT recipe excludes them."""
+    if cfg.wd_mask == "all":
+        return None
+    if cfg.wd_mask == "exclude_1d":
+        def mask(params):
+            return jax.tree_util.tree_map(
+                lambda p: getattr(p, "ndim", 0) >= 2, params)
+        return mask
+    raise ValueError(f"unknown wd_mask {cfg.wd_mask!r}")
+
+
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
     parts: list[optax.GradientTransformation] = []
@@ -84,6 +100,7 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
     name = cfg.name.lower()
     mdt = _moment_dtype(cfg)
+    mask = _wd_mask(cfg)
     if name == "sgd":
         parts.append(optax.sgd(sched))
     elif name == "momentum":
@@ -93,9 +110,10 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
         parts.append(optax.adam(sched, mu_dtype=mdt))
     elif name == "adamw":
         parts.append(optax.adamw(sched, weight_decay=cfg.weight_decay,
-                                 mu_dtype=mdt))
+                                 mu_dtype=mdt, mask=mask))
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
     if cfg.weight_decay > 0 and name not in ("adamw",):
-        parts.insert(-1, optax.add_decayed_weights(cfg.weight_decay))
+        parts.insert(-1, optax.add_decayed_weights(cfg.weight_decay,
+                                                   mask=mask))
     return optax.chain(*parts)

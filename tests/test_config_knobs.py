@@ -213,6 +213,43 @@ def test_piecewise_boundaries_are_absolute_under_warmup():
             warmup_steps=50))
 
 
+@pytest.mark.parametrize("opt", ["adamw", "momentum"])
+def test_weight_decay_mask_excludes_1d(opt):
+    """The standard decay recipe: matrices decay, biases/LN scales do
+    not. Zero grads make the adam/momentum term exactly 0, so lr=1.0
+    with wd=0.1 cleanly isolates the decay term: a decayed leaf shrinks
+    and an excluded one stays frozen."""
+    import optax
+
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+    params = {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    tx = make_optimizer(OptimizerConfig(name=opt, learning_rate=1.0,
+                                        weight_decay=0.1))
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    assert float(jnp.max(jnp.abs(new["kernel"] - 1.0))) > 0   # decayed
+    np.testing.assert_array_equal(np.asarray(new["bias"]),
+                                  np.ones(4))                  # excluded
+
+    tx_all = make_optimizer(OptimizerConfig(name=opt, learning_rate=1.0,
+                                            weight_decay=0.1,
+                                            wd_mask="all"))
+    updates, _ = tx_all.update(grads, tx_all.init(params), params)
+    new = optax.apply_updates(params, updates)
+    assert float(jnp.max(jnp.abs(new["bias"] - 1.0))) > 0      # decays too
+
+
+def test_wd_mask_rejects_garbage():
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+    with pytest.raises(ValueError, match="wd_mask"):
+        make_optimizer(OptimizerConfig(name="adamw", weight_decay=0.1,
+                                       wd_mask="bogus"))
+
+
 def test_exponential_schedule():
     """tf.train.exponential_decay parity: lr * rate^(step/decay_steps),
     continuous (staircase off)."""
