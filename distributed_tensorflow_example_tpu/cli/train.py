@@ -342,8 +342,11 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
         max_pred = bert_cfg.max_predictions if bert_cfg else 20
         seq_len = cfg.data.seq_len
         if bert_cfg and seq_len > bert_cfg.max_len:
-            # positions >= max_len would silently clamp the pos-embedding
-            # gather under jit — same silent-divergence class as vocab
+            # defense in depth for models constructed OUTSIDE the
+            # registry: the registered factories grow max_len to cover
+            # seq_len, so this cannot fire for them — but positions >=
+            # max_len would silently clamp the pos-embedding gather
+            # under jit, so keep the hard stop for hand-built models
             raise SystemExit(
                 f"--seq_len {seq_len} exceeds the model's max_len "
                 f"{bert_cfg.max_len}")

@@ -327,15 +327,19 @@ class Bert:
 
 
 def _make(config: TrainConfig, cfg: BertConfig, *,
-          config_vocab: bool = True) -> Bert:
-    """One factory for every size: knob threading lives in ONE place so
-    the registered variants can never diverge."""
+          config_vocab: bool = True, cls: type = None) -> Bert:
+    """One factory for every size AND family (MoeBert passes ``cls``):
+    knob threading lives in ONE place so registered variants can never
+    diverge."""
     if config_vocab:
         cfg.vocab_size = config.data.vocab_size
-    return Bert(cfg, dtype=resolve_dtype(config.dtype),
-                attention_impl=config.attention_impl,
-                param_dtype=resolve_dtype(config.param_dtype),
-                remat=config.remat)
+    # long-context runs size the position table by the requested seq_len
+    # (--seq_len 4096 just works; the default max_len stays the floor)
+    cfg.max_len = max(cfg.max_len, config.data.seq_len)
+    return (cls or Bert)(cfg, dtype=resolve_dtype(config.dtype),
+                         attention_impl=config.attention_impl,
+                         param_dtype=resolve_dtype(config.param_dtype),
+                         remat=config.remat)
 
 
 @register_model("bert")

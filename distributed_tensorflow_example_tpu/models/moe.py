@@ -152,17 +152,12 @@ class MoeBert(Bert):
 
 @register_model("moe_bert")
 def _make_moe_bert(config: TrainConfig) -> MoeBert:
-    cfg = MoeBertConfig()
-    cfg.vocab_size = config.data.vocab_size
-    return MoeBert(cfg, dtype=resolve_dtype(config.dtype),
-                   attention_impl=config.attention_impl,
-                   param_dtype=resolve_dtype(config.param_dtype),
-                   remat=config.remat)
+    from .bert import _make
+    return _make(config, MoeBertConfig(), cls=MoeBert)
 
 
 @register_model("moe_bert_tiny")
 def _make_moe_bert_tiny(config: TrainConfig) -> MoeBert:
-    return MoeBert(MoeBertConfig.tiny(), dtype=resolve_dtype(config.dtype),
-                   attention_impl=config.attention_impl,
-                   param_dtype=resolve_dtype(config.param_dtype),
-                   remat=config.remat)
+    from .bert import _make
+    return _make(config, MoeBertConfig.tiny(), config_vocab=False,
+                 cls=MoeBert)
