@@ -71,7 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="training steps per device dispatch (lax.scan "
                         "inner loop; hook cadences must be multiples)")
     p.add_argument("--learning_rate", type=float, default=0.5)
-    p.add_argument("--optimizer", default="sgd")
+    p.add_argument("--optimizer", default="sgd", type=str.lower,
+                   choices=["sgd", "momentum", "adam", "adamw",
+                            "lars", "lamb"],
+                   help="base optimizer (lars/lamb: the large-batch "
+                        "ImageNet/BERT recipes for sync-DP scaling)")
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--wd_mask", default="exclude_1d",
@@ -82,10 +86,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="linear LR warmup steps")
     p.add_argument("--decay_schedule", default="constant",
                    choices=["constant", "cosine", "linear", "piecewise",
-                            "exponential"])
+                            "exponential", "polynomial"])
     p.add_argument("--decay_steps", type=int, default=0,
                    help="exponential: steps per decay_factor application "
-                        "(tf.train.exponential_decay parity)")
+                        "(tf.train.exponential_decay parity); polynomial: "
+                        "absolute step where decay bottoms out (falls "
+                        "back to --train_steps)")
+    p.add_argument("--end_learning_rate", type=float, default=0.0,
+                   help="polynomial: floor LR (tf.train.polynomial_decay)")
+    p.add_argument("--decay_power", type=float, default=1.0,
+                   help="polynomial: exponent (1.0 = linear BERT recipe)")
     p.add_argument("--decay_boundaries", default="",
                    help="comma-separated steps where piecewise LR drops "
                         "(e.g. '30000,60000,80000')")
@@ -235,6 +245,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                                       if b.strip()),
                                   decay_factor=args.decay_factor,
                                   decay_steps=args.decay_steps,
+                                  end_learning_rate=args.end_learning_rate,
+                                  decay_power=args.decay_power,
                                   grad_clip_norm=args.grad_clip_norm,
                                   moment_dtype=args.moment_dtype,
                                   total_steps=args.train_steps),

@@ -51,7 +51,8 @@ class OptimizerConfig:
     """Base-optimizer knobs (reference: GradientDescent under
     SyncReplicasOptimizer, SURVEY.md §2.1)."""
 
-    name: str = "sgd"               # sgd | momentum | adam | adamw
+    name: str = "sgd"               # sgd | momentum | adam | adamw |
+                                    # lars | lamb (large-batch recipes)
     learning_rate: float = 0.5
     momentum: float = 0.9
     weight_decay: float = 0.0
@@ -64,13 +65,21 @@ class OptimizerConfig:
                                     # recorded artifact used nonzero wd)
     warmup_steps: int = 0
     decay_schedule: str = "constant"  # constant | cosine | linear |
-                                      # piecewise | exponential
+                                      # piecewise | exponential | polynomial
     decay_boundaries: tuple[int, ...] = ()  # piecewise: steps where LR drops
     decay_factor: float = 0.1       # piecewise: multiplier at each boundary;
                                     # exponential: decay rate per decay_steps
     decay_steps: int = 0            # exponential: steps per decay_factor
                                     # application (tf.train.exponential_decay
-                                    # 'decay_steps'); staircase off
+                                    # 'decay_steps'); staircase off.
+                                    # polynomial: absolute step where the
+                                    # decay bottoms out (falls back to
+                                    # total_steps when 0)
+    end_learning_rate: float = 0.0  # polynomial: floor LR
+                                    # (tf.train.polynomial_decay
+                                    # 'end_learning_rate')
+    decay_power: float = 1.0        # polynomial: exponent ('power';
+                                    # 1.0 = the linear BERT recipe)
     total_steps: int = 0            # for schedules; 0 => constant
     grad_clip_norm: float = 0.0     # 0 disables
     moment_dtype: str = "float32"   # float32 | bfloat16 — first-moment

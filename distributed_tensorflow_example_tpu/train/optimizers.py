@@ -64,6 +64,34 @@ def make_schedule(cfg: OptimizerConfig):
         sched = optax.exponential_decay(init,
                                         transition_steps=cfg.decay_steps,
                                         decay_rate=cfg.decay_factor)
+    elif cfg.decay_schedule == "polynomial":
+        # tf.train.polynomial_decay parity (the original BERT recipe is
+        # power=1.0 over num_train_steps): (base-end)*(1 - t/T)^power +
+        # end at ABSOLUTE step t, like piecewise/exponential. The decay
+        # runs from step 0 even under warmup (bert/optimization.py
+        # semantics: warmup overrides the ramp, the polynomial is never
+        # rebased — so LR steps down to base*(1-warmup/T) when warmup
+        # ends, the recipe's documented quirk). join_schedules feeds the
+        # post-warmup schedule (t - warmup), so shift back via
+        # transition_begin to keep the tf formula exact at every
+        # absolute step >= warmup_steps
+        horizon = cfg.decay_steps if cfg.decay_steps > 0 else cfg.total_steps
+        if horizon <= cfg.warmup_steps:
+            raise ValueError(
+                "decay_schedule='polynomial' needs decay_steps (or "
+                f"total_steps) > warmup_steps; got horizon={horizon}, "
+                f"warmup_steps={cfg.warmup_steps}")
+        poly = optax.polynomial_schedule(
+            base, cfg.end_learning_rate, cfg.decay_power, horizon)
+        if cfg.warmup_steps > 0:
+            # optax clamps negative transition_begin to 0, so un-rebase
+            # the joined count by hand
+            warmup = cfg.warmup_steps
+
+            def sched(count, _poly=poly, _w=warmup):
+                return _poly(count + _w)
+        else:
+            sched = poly
     elif cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
         sched = optax.constant_schedule(base)
     elif cfg.decay_schedule == "cosine":
@@ -111,9 +139,34 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     elif name == "adamw":
         parts.append(optax.adamw(sched, weight_decay=cfg.weight_decay,
                                  mu_dtype=mdt, mask=mask))
+    elif name == "lars":
+        # layer-wise trust ratio for large-batch SGD (the 32k-batch
+        # ImageNet recipe) — the natural partner of sync-DP scaling.
+        # Biases/norm scales are excluded from BOTH decay and the trust
+        # ratio under the default wd_mask (the published recipe); a
+        # `True` mask applies it everywhere (wd_mask="all")
+        if cfg.moment_dtype != "float32":
+            raise ValueError(
+                "moment_dtype=bfloat16 is not supported for lars "
+                "(optax.lars exposes no accumulator dtype); the flag "
+                "would be a silent no-op")
+        lmask = mask if mask is not None else True
+        parts.append(optax.lars(sched, weight_decay=cfg.weight_decay,
+                                weight_decay_mask=lmask,
+                                trust_ratio_mask=lmask,
+                                momentum=cfg.momentum))
+    elif name == "lamb":
+        # LARS's Adam sibling (the 64k-batch BERT pretraining recipe)
+        if cfg.moment_dtype != "float32":
+            raise ValueError(
+                "moment_dtype=bfloat16 is not supported for lamb "
+                "(optax.lamb exposes no mu_dtype); the flag would be a "
+                "silent no-op")
+        parts.append(optax.lamb(sched, weight_decay=cfg.weight_decay,
+                                mask=mask))
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
-    if cfg.weight_decay > 0 and name not in ("adamw",):
+    if cfg.weight_decay > 0 and name not in ("adamw", "lars", "lamb"):
         parts.insert(-1, optax.add_decayed_weights(cfg.weight_decay,
                                                    mask=mask))
     return optax.chain(*parts)

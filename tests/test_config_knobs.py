@@ -318,3 +318,103 @@ def test_compute_dtype_bf16_traces_and_logits_f32(name):
         lambda p, e, b: m.loss(p, e, b, jax.random.key(1))[0],
         params, extras, batch)
     assert loss_shape.dtype == jnp.float32
+
+
+def test_polynomial_schedule():
+    """tf.train.polynomial_decay parity: (lr0-end)*(1-t/T)^p + end, then
+    hold at end_learning_rate."""
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    sched = make_schedule(OptimizerConfig(
+        learning_rate=1.0, decay_schedule="polynomial",
+        decay_steps=100, end_learning_rate=0.1, decay_power=2.0))
+    assert float(sched(0)) == pytest.approx(1.0)
+    # (1.0-0.1)*(1-0.5)^2 + 0.1
+    assert float(sched(50)) == pytest.approx(0.9 * 0.25 + 0.1, rel=1e-5)
+    assert float(sched(100)) == pytest.approx(0.1)
+    assert float(sched(500)) == pytest.approx(0.1)      # holds at floor
+
+
+def test_polynomial_schedule_bert_recipe():
+    """power=1.0 + warmup is the original BERT recipe
+    (bert/optimization.py): linear ramp to base over warmup_steps while
+    the polynomial decays from step 0 — so post-warmup LR is the
+    UN-rebased tf.train.polynomial_decay value base*(1 - t/T), including
+    the recipe's documented step-down right after warmup ends."""
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    sched = make_schedule(OptimizerConfig(
+        learning_rate=1e-4, decay_schedule="polynomial",
+        total_steps=1000, warmup_steps=100))
+    assert float(sched(50)) == pytest.approx(0.5e-4, rel=1e-5)   # mid-warmup
+    assert float(sched(100)) == pytest.approx(0.9e-4, rel=1e-5)  # 1 - 100/1000
+    assert float(sched(550)) == pytest.approx(0.45e-4, rel=1e-5)  # 1 - 550/1000
+    assert float(sched(1000)) == pytest.approx(0.0, abs=1e-12)
+    with pytest.raises(ValueError, match="polynomial"):
+        make_schedule(OptimizerConfig(decay_schedule="polynomial",
+                                      total_steps=50, warmup_steps=50))
+
+
+def test_lars_lamb_reject_bf16_moments():
+    """optax.lars/lamb expose no accumulator dtype: the flag must hard
+    error rather than silently no-op."""
+    for name in ("lars", "lamb"):
+        with pytest.raises(ValueError, match="moment_dtype"):
+            make_optimizer(OptimizerConfig(name=name,
+                                           moment_dtype="bfloat16"))
+
+
+@pytest.mark.parametrize("opt", ["lars", "lamb"])
+def test_large_batch_optimizer_trains(opt):
+    """lars/lamb run end to end under SyncReplicas and the loss drops
+    (the large-batch recipes the sync-DP scaling story pairs with)."""
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    mesh = local_mesh(1, {"data": 1})
+    tx = make_optimizer(OptimizerConfig(name=opt, learning_rate=0.05,
+                                        weight_decay=1e-4))
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init)
+    batch = m.dummy_batch(64)
+    losses = []
+    for _ in range(8):
+        state, metrics = sync.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_lars_trust_ratio_scale_invariance():
+    """The LARS property: the update direction is normalized per layer
+    (||update|| ~ trust_coefficient * ||param||), so scaling the
+    gradient by 100x leaves the update norm unchanged — unlike sgd."""
+    import optax
+
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+    params = {"kernel": jnp.ones((8, 8))}
+    g1 = {"kernel": jnp.full((8, 8), 0.01)}
+    g2 = {"kernel": jnp.full((8, 8), 1.0)}
+    tx = make_optimizer(OptimizerConfig(name="lars", learning_rate=1.0,
+                                        momentum=0.0))
+    u1, _ = tx.update(g1, tx.init(params), params)
+    u2, _ = tx.update(g2, tx.init(params), params)
+    n1 = float(optax.global_norm(u1))
+    n2 = float(optax.global_norm(u2))
+    assert n1 == pytest.approx(n2, rel=1e-5)
+    assert n1 > 0
+
+
+def test_lamb_bias_excluded_from_decay_by_default():
+    """wd_mask=exclude_1d reaches lamb's decay mask: with zero grads the
+    adam term is 0, so only decayed leaves move."""
+    import optax
+
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+    params = {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    tx = make_optimizer(OptimizerConfig(name="lamb", learning_rate=1.0,
+                                        weight_decay=0.1))
+    updates, _ = tx.update(grads, tx.init(params), params)
+    new = optax.apply_updates(params, updates)
+    assert float(jnp.max(jnp.abs(new["kernel"] - 1.0))) > 0   # decayed
+    np.testing.assert_array_equal(np.asarray(new["bias"]), np.ones(4))
