@@ -108,6 +108,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "recipe uses 0.1)")
     p.add_argument("--grad_clip_norm", type=float, default=0.0,
                    help="global-norm gradient clipping (0 disables)")
+    p.add_argument("--ema_decay", type=float, default=0.0,
+                   help="shadow-param EMA decay "
+                        "(tf.train.ExponentialMovingAverage parity; "
+                        "0 disables; eval runs on the shadow)")
+    p.add_argument("--ema_debias", action="store_true",
+                   help="tf num_updates ramp: min(decay, (1+n)/(10+n))")
     p.add_argument("--moment_dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="optimizer first-moment storage dtype (Adam mu / "
@@ -249,6 +255,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                                   decay_power=args.decay_power,
                                   grad_clip_norm=args.grad_clip_norm,
                                   moment_dtype=args.moment_dtype,
+                                  ema_decay=args.ema_decay,
+                                  ema_debias=args.ema_debias,
                                   total_steps=args.train_steps),
         sync=SyncConfig(accum_steps=args.accum_steps, mode=args.sync_mode),
         checkpoint=CheckpointConfig(

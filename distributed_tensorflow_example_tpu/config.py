@@ -85,6 +85,11 @@ class OptimizerConfig:
     moment_dtype: str = "float32"   # float32 | bfloat16 — first-moment
                                     # (mu / momentum buffer) storage dtype;
                                     # bf16 halves that HBM traffic slice
+    ema_decay: float = 0.0          # > 0 maintains a shadow-param EMA
+                                    # (tf.train.ExponentialMovingAverage
+                                    # parity); eval uses the shadow
+    ema_debias: bool = False        # tf 'num_updates' ramp:
+                                    # min(decay, (1+n)/(10+n))
 
 
 @dataclasses.dataclass

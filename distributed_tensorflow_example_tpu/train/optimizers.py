@@ -17,6 +17,8 @@ is why this knob never touches it.
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -121,6 +123,71 @@ def _wd_mask(cfg: OptimizerConfig):
     raise ValueError(f"unknown wd_mask {cfg.wd_mask!r}")
 
 
+class EmaState(NamedTuple):
+    """State of :func:`params_ema`: the shadow-parameter pytree plus the
+    update counter feeding the tf ``num_updates`` decay ramp."""
+
+    count: jax.Array     # i32 scalar: applied updates so far
+    ema: Any             # shadow params, same tree/dtypes as params
+
+
+def params_ema(decay: float, debias: bool = False
+               ) -> optax.GradientTransformation:
+    """``tf.train.ExponentialMovingAverage`` parity as a chain link.
+
+    The reference era maintained shadow variables updated after each
+    ``apply_gradients`` (``ema.apply(vars)`` under control_dependencies);
+    here the shadow tree rides in the optimizer state — it is updated in
+    the same compiled step, checkpointed with the state, and sharded by
+    the same path rules as its parameters (state_shardings matches on
+    the param names embedded in the opt-state path).
+
+    ``debias=True`` is the ``num_updates`` ramp:
+    ``min(decay, (1+n)/(10+n))`` — tf's recommended warmup so early
+    steps don't anchor the average at the init values. Shadows start at
+    the initial params, exactly like ``ema.apply`` on freshly
+    initialized variables, and are stored in float32 regardless of
+    ``param_dtype`` — at decay 0.999 a bf16 shadow would round away the
+    1e-3-scale increments and freeze at init. Must be the LAST link in
+    the chain: it reads the final updates to see the post-step params.
+    """
+
+    def init_fn(params):
+        return EmaState(
+            jnp.zeros((), jnp.int32),
+            jax.tree_util.tree_map(lambda p: p.astype(jnp.float32),
+                                   params))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("params_ema needs params in tx.update")
+        new_params = optax.apply_updates(params, updates)
+        count = state.count + 1
+        if debias:
+            n = count.astype(jnp.float32)
+            d = jnp.minimum(decay, (1.0 + n) / (10.0 + n))
+        else:
+            d = jnp.float32(decay)
+        ema = jax.tree_util.tree_map(
+            lambda e, p: e * d + p.astype(jnp.float32) * (1.0 - d),
+            state.ema, new_params)
+        return updates, EmaState(count, ema)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def find_ema_params(opt_state: Any) -> Any | None:
+    """Pull the shadow-param tree out of an optimizer state, traversing
+    wrappers (MultiSteps, chain tuples). None when EMA is not enabled —
+    callers fall back to the live params."""
+    leaves = jax.tree_util.tree_leaves(
+        opt_state, is_leaf=lambda x: isinstance(x, EmaState))
+    for leaf in leaves:
+        if isinstance(leaf, EmaState):
+            return leaf.ema
+    return None
+
+
 def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     sched = make_schedule(cfg)
     parts: list[optax.GradientTransformation] = []
@@ -169,4 +236,8 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     if cfg.weight_decay > 0 and name not in ("adamw", "lars", "lamb"):
         parts.insert(-1, optax.add_decayed_weights(cfg.weight_decay,
                                                    mask=mask))
+    if cfg.ema_decay > 0:
+        # last link: sees the final updates, so the shadow tracks
+        # post-step params (tf control_dependencies ordering)
+        parts.append(params_ema(cfg.ema_decay, debias=cfg.ema_debias))
     return optax.chain(*parts)

@@ -35,7 +35,7 @@ from ..parallel.sync_replicas import SyncReplicas
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsLogger
 from . import hooks as hooks_lib
-from .optimizers import make_optimizer
+from .optimizers import find_ema_params, make_optimizer
 from .state import TrainState, param_count
 
 log = get_logger("trainer")
@@ -328,9 +328,14 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def evaluate(self, state: TrainState,
-                 batch_size: int | None = None) -> dict[str, float]:
+                 batch_size: int | None = None,
+                 use_ema: bool | None = None) -> dict[str, float]:
         """Forward-only metrics over the eval set (the reference's final
         test-accuracy pass, SURVEY.md §2.1 'Train loop + eval').
+
+        When ``ema_decay`` is on, eval runs on the shadow parameters (the
+        ``ema.variables_to_restore()`` eval recipe); pass
+        ``use_ema=False`` to eval the live params instead.
 
         Static-shape discipline: the tail batch is padded up to ``bs`` with
         repeated rows and excluded via a ``__valid__`` example mask that
@@ -339,6 +344,18 @@ class Trainer:
         recompile; ``self._eval_fn._cache_size() == 1``)."""
         if self._eval_fn is None:
             self._eval_fn = jax.jit(self.model.eval_metrics)
+        params = state.params
+        explicit = use_ema is not None
+        if use_ema is None:
+            use_ema = self.config.optimizer.ema_decay > 0
+        if use_ema:
+            ema = find_ema_params(state.opt_state)
+            if ema is not None:
+                params = ema
+            elif explicit:
+                raise ValueError(
+                    "use_ema=True but the optimizer state holds no EMA "
+                    "shadow (ema_decay is 0 for this run)")
         bs = batch_size or self.config.data.batch_size
         n = len(next(iter(self.eval_arrays.values())))
         # bs stays the configured (mesh-divisible) batch even when the eval
@@ -361,7 +378,7 @@ class Trainer:
             batch["__valid__"] = mask
             placed = self.sync.shard_batch(batch)
             out = jax.device_get(
-                self._eval_fn(state.params, state.extras, placed))
+                self._eval_fn(params, state.extras, placed))
             for k, v in out.items():
                 totals[k] = totals.get(k, 0.0) + float(v) * m
             count += m

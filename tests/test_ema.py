@@ -1,0 +1,220 @@
+"""Shadow-parameter EMA (tf.train.ExponentialMovingAverage parity).
+
+The reference stack maintained shadow variables updated after each
+apply_gradients; here the shadow tree rides in the optimizer state
+(train/optimizers.py params_ema), so it is compiled into the step,
+checkpointed with the state, and sharded like its parameters.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                       DataConfig,
+                                                       MeshShape,
+                                                       OptimizerConfig,
+                                                       SyncConfig,
+                                                       TrainConfig)
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.train.optimizers import (
+    EmaState, find_ema_params, make_optimizer, params_ema)
+
+
+def test_ema_closed_form():
+    """3 sgd steps with constant grads: shadow must equal the hand-rolled
+    recurrence ema <- d*ema + (1-d)*params_after_step."""
+    d = 0.9
+    lr = 0.1
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=lr,
+                                        ema_decay=d))
+    params = {"w": jnp.array([1.0, 2.0])}
+    grads = {"w": jnp.array([1.0, -1.0])}
+    state = tx.init(params)
+
+    exp_p = np.array([1.0, 2.0])
+    exp_ema = exp_p.copy()
+    for _ in range(3):
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        exp_p = exp_p - lr * np.array([1.0, -1.0])
+        exp_ema = d * exp_ema + (1 - d) * exp_p
+        np.testing.assert_allclose(np.asarray(params["w"]), exp_p,
+                                   rtol=1e-6)
+        ema = find_ema_params(state)
+        np.testing.assert_allclose(np.asarray(ema["w"]), exp_ema,
+                                   rtol=1e-6)
+
+
+def test_ema_debias_ramp():
+    """num_updates ramp: effective decay at update n is
+    min(decay, (1+n)/(10+n)) — so update 1 uses 2/11, not 0.999."""
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.5,
+                                        ema_decay=0.999, ema_debias=True))
+    params = {"w": jnp.array([0.0])}
+    grads = {"w": jnp.array([-2.0])}   # step: w -> 1.0
+    state = tx.init(params)
+    updates, state = tx.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    assert float(new["w"][0]) == pytest.approx(1.0)
+    d1 = 2.0 / 11.0
+    expected = d1 * 0.0 + (1 - d1) * 1.0
+    np.testing.assert_allclose(np.asarray(find_ema_params(state)["w"]),
+                               [expected], rtol=1e-6)
+
+
+def test_ema_initialized_at_init_params():
+    tx = make_optimizer(OptimizerConfig(name="adam", ema_decay=0.99))
+    params = {"k": jnp.ones((3, 3))}
+    ema = find_ema_params(tx.init(params))
+    np.testing.assert_array_equal(np.asarray(ema["k"]), np.ones((3, 3)))
+
+
+def test_find_ema_none_when_disabled():
+    tx = make_optimizer(OptimizerConfig(name="adam"))
+    assert find_ema_params(tx.init({"k": jnp.ones((2,))})) is None
+
+
+def test_ema_threads_through_sync_replicas_and_accum():
+    """EMA advances once per *applied* step under microbatch accumulation
+    (the accumulate-N-then-apply residue of the PS protocol) and stays
+    consistent with the params trajectory."""
+    cfg = TrainConfig(model="mlp",
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.1,
+                                                ema_decay=0.5))
+    m = get_model("mlp", cfg)
+    mesh = local_mesh(2, {"data": 2})
+    tx = make_optimizer(cfg.optimizer)
+    sync = SyncReplicas(m.loss, tx, mesh, sync=SyncConfig(accum_steps=2))
+    state = sync.init(m.init)
+    batch = m.dummy_batch(32)
+    for _ in range(3):
+        state, _ = sync.step(state, batch)
+    ema = find_ema_params(state.opt_state)
+    assert ema is not None
+    # shadow lags the live params but is no longer the init values
+    diffs = jax.tree_util.tree_map(
+        lambda e, p: float(jnp.max(jnp.abs(e - p))), ema, state.params)
+    assert max(jax.tree_util.tree_leaves(diffs)) > 0
+
+
+def test_eval_uses_shadow_params(tmp_path):
+    """Trainer.evaluate defaults to the shadow when ema is on — and a
+    deliberately stale shadow (decay ~1.0 freezes it at init) yields
+    different metrics from the trained live params."""
+    from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    data = synthetic_mnist(1024, 256)
+    cfg = TrainConfig(model="mlp", train_steps=60, mesh=MeshShape(data=1),
+                      data=DataConfig(batch_size=128),
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.5,
+                                                ema_decay=0.9999))
+    model = get_model("mlp", cfg)
+    mesh = local_mesh(1, {"data": 1})
+    tr = Trainer(model, cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 eval_arrays={"x": data["test_x"], "y": data["test_y"]},
+                 mesh=mesh, process_index=0, num_processes=1)
+    state, _ = tr.train()
+    live = tr.evaluate(state, use_ema=False)
+    shadow = tr.evaluate(state)           # default: shadow when ema on
+    # 60 steps trains the live params well past a frozen-at-init shadow
+    assert live["accuracy"] > shadow["accuracy"] + 0.1, (live, shadow)
+
+
+def test_ema_shadow_stays_f32_under_bf16_params():
+    """At decay 0.999 a bf16 shadow would round the 1e-3-scale
+    increments to zero and freeze at init — the shadow must be f32
+    regardless of param_dtype."""
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.25,
+                                        ema_decay=0.999))
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    grads = {"w": jnp.ones((4,), jnp.bfloat16)}
+    state = tx.init(params)
+    ema0 = find_ema_params(state)
+    assert ema0["w"].dtype == jnp.float32
+    for _ in range(4):
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    ema = find_ema_params(state)
+    assert float(jnp.max(jnp.abs(ema["w"] - 1.0))) > 0  # it moved
+
+
+def test_explicit_use_ema_without_ema_raises():
+    from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    data = synthetic_mnist(256, 128)
+    cfg = TrainConfig(model="mlp", train_steps=1,
+                      data=DataConfig(batch_size=64))
+    model = get_model("mlp", cfg)
+    tr = Trainer(model, cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 eval_arrays={"x": data["test_x"], "y": data["test_y"]},
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    state, _ = tr.train()
+    with pytest.raises(ValueError, match="use_ema"):
+        tr.evaluate(state, use_ema=True)
+
+
+def test_ema_checkpoint_roundtrip(tmp_path):
+    """The shadow tree is part of opt_state, so save/restore carries it
+    bit-exactly (Saver parity extends to EMA slots, like tf saved shadow
+    variables by their slot names)."""
+    from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+        CheckpointManager)
+
+    cfg = OptimizerConfig(name="momentum", learning_rate=0.05,
+                          ema_decay=0.8)
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    mesh = local_mesh(1, {"data": 1})
+    tx = make_optimizer(cfg)
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init)
+    batch = m.dummy_batch(16)
+    for _ in range(2):
+        state, _ = sync.step(state, batch)
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(state, step=2)
+    template = sync.init(m.init)
+    restored = mgr.restore(template, step=2)
+    a = find_ema_params(state.opt_state)
+    b = find_ema_params(restored.opt_state)
+    assert b is not None
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)), a, b)
+
+
+def test_ema_state_is_sharding_compatible():
+    """state_shardings must produce a spec for every EmaState leaf (the
+    shadow tree inherits param layouts through the opt-state path)."""
+    from distributed_tensorflow_example_tpu.parallel.sharding import (
+        ShardingRules, state_shardings)
+
+    cfg = TrainConfig(model="mlp",
+                      optimizer=OptimizerConfig(name="adam", ema_decay=0.9))
+    m = get_model("mlp", cfg)
+    mesh = local_mesh(2, {"data": 1, "fsdp": 2})
+    tx = make_optimizer(cfg.optimizer)
+    sync = SyncReplicas(m.loss, tx, mesh,
+                        rules=ShardingRules(fsdp_axis_size=2))
+    state = sync.init(m.init)
+    shardings = state_shardings(mesh, state,
+                                ShardingRules(fsdp_axis_size=2))
+    n_state = len(jax.tree_util.tree_leaves(state))
+    n_shard = len(jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "spec")))
+    assert n_state == n_shard
+    state, _ = sync.step(state, m.dummy_batch(16))
+    assert find_ema_params(state.opt_state) is not None
