@@ -135,6 +135,122 @@ int dl_cifar_read(const char* path, float* out_x, int32_t* out_y,
 }
 
 // ---------------------------------------------------------------------------
+// TFRecord container (the tf.python_io / tf.io on-disk format)
+// ---------------------------------------------------------------------------
+// Per record: u64le length | u32le masked_crc32c(length bytes)
+//           | data bytes   | u32le masked_crc32c(data).
+// CRC is CRC-32C (Castagnoli, reflected poly 0x82f63b78);
+// mask(c) = rotr(c,15) + 0xa282ead8. C++ owns the byte scan (index +
+// integrity check off the GIL); Python (data/tfrecord.py) owns record
+// framing, the writer, and the Example proto codec.
+
+static uint32_t kCrcTable[8][256];
+static std::atomic<bool> g_crc_ready{false};
+static std::mutex g_crc_mu;
+
+static void crc32c_init() {
+  if (g_crc_ready.load(std::memory_order_acquire)) return;
+  std::lock_guard<std::mutex> lk(g_crc_mu);
+  if (g_crc_ready.load(std::memory_order_relaxed)) return;
+  const uint32_t poly = 0x82f63b78u;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+    kCrcTable[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int t = 1; t < 8; ++t)
+      kCrcTable[t][i] =
+          (kCrcTable[t - 1][i] >> 8) ^ kCrcTable[0][kCrcTable[t - 1][i] & 0xff];
+  g_crc_ready.store(true, std::memory_order_release);
+}
+
+// Slicing-by-8 CRC-32C (little-endian host; this sandbox is x86-64).
+uint32_t dl_crc32c(const unsigned char* p, int64_t n) {
+  crc32c_init();
+  uint32_t c = 0xffffffffu;
+  while (n >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= c;
+    c = kCrcTable[7][w & 0xff] ^ kCrcTable[6][(w >> 8) & 0xff] ^
+        kCrcTable[5][(w >> 16) & 0xff] ^ kCrcTable[4][(w >> 24) & 0xff] ^
+        kCrcTable[3][(w >> 32) & 0xff] ^ kCrcTable[2][(w >> 40) & 0xff] ^
+        kCrcTable[1][(w >> 48) & 0xff] ^ kCrcTable[0][(w >> 56) & 0xff];
+    p += 8;
+    n -= 8;
+  }
+  while (n-- > 0) c = (c >> 8) ^ kCrcTable[0][(c ^ *p++) & 0xff];
+  return c ^ 0xffffffffu;
+}
+
+static uint32_t mask_crc(uint32_t c) {
+  return ((c >> 15) | (c << 17)) + 0xa282ead8u;
+}
+
+// Scan a TFRecord file. Returns the record count (>=0) or a negative
+// error: -1 open, -2 truncated header, -3 bad length crc, -4 truncated
+// data, -5 bad data crc, -6 capacity too small. offsets/lengths (both
+// null for a count-only pass) receive each record's DATA offset/length.
+// verify != 0 checks both CRCs per record.
+int64_t dl_tfrecord_index(const char* path, int64_t* offsets,
+                          int64_t* lengths, int64_t capacity, int verify) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  int64_t fsize = (int64_t)ftell(f);
+  fseek(f, 0, SEEK_SET);
+  int64_t count = 0;
+  std::vector<unsigned char> buf;
+  unsigned char hdr[12];
+  for (;;) {
+    size_t got = fread(hdr, 1, 12, f);
+    if (got == 0) break;                       // clean EOF
+    if (got != 12) { fclose(f); return -2; }
+    uint64_t len;
+    memcpy(&len, hdr, 8);
+    if (verify) {
+      uint32_t want;
+      memcpy(&want, hdr + 8, 4);
+      if (mask_crc(dl_crc32c(hdr, 8)) != want) { fclose(f); return -3; }
+    }
+    // bound-check in unsigned space: a corrupt length with the high bit
+    // set must hit -4, not wrap negative and pass (then fseek backwards
+    // and loop forever)
+    int64_t data_off = (int64_t)ftell(f);
+    if (fsize - data_off < 4 || len > (uint64_t)(fsize - data_off - 4)) {
+      fclose(f);
+      return -4;
+    }
+    if (offsets && lengths) {
+      if (count >= capacity) { fclose(f); return -6; }
+      offsets[count] = data_off;
+      lengths[count] = (int64_t)len;
+    }
+    if (verify) {
+      buf.resize(len);
+      if (len && fread(buf.data(), 1, (size_t)len, f) != len) {
+        fclose(f);
+        return -4;
+      }
+      unsigned char fc[4];
+      if (fread(fc, 1, 4, f) != 4) { fclose(f); return -4; }
+      uint32_t want;
+      memcpy(&want, fc, 4);
+      if (mask_crc(dl_crc32c(buf.data(), (int64_t)len)) != want) {
+        fclose(f);
+        return -5;
+      }
+    } else {
+      fseek(f, (long)(len + 4), SEEK_CUR);
+    }
+    ++count;
+  }
+  fclose(f);
+  return count;
+}
+
+// ---------------------------------------------------------------------------
 // Threaded batch-assembly ring
 // ---------------------------------------------------------------------------
 
@@ -305,6 +421,6 @@ void dl_destroy(DLoader* L) {
 
 // Version tag for Python-side compatibility checks. v2: N-array batches
 // (dl_create takes array/row-byte vectors, dl_acquire fills N pointers).
-int dl_abi_version() { return 2; }
+int dl_abi_version() { return 3; }
 
 }  // extern "C"

@@ -116,8 +116,11 @@ def apply_mlm_masking(seqs: np.ndarray, *, vocab_size: int,
 
 
 def load_tokenized(data_dir: str) -> tuple[np.ndarray, np.ndarray]:
-    """Pre-tokenized [N,S] int32 arrays: train.npy + test.npy, or a single
-    tokens.npy split 95/5."""
+    """Pre-tokenized [N,S] int32 arrays: train.npy + test.npy, a single
+    tokens.npy split 95/5, or TFRecords of ``tf.train.Example`` records
+    carrying an ``input_ids`` Int64List — the BERT
+    create_pretraining_data format (``train*.tfrecord`` +
+    ``test*.tfrecord``, or any ``*.tfrecord`` split 95/5)."""
     tr, te = (os.path.join(data_dir, f) for f in ("train.npy", "test.npy"))
     if os.path.exists(tr) and os.path.exists(te):
         return np.load(tr).astype(np.int32), np.load(te).astype(np.int32)
@@ -126,8 +129,20 @@ def load_tokenized(data_dir: str) -> tuple[np.ndarray, np.ndarray]:
         toks = np.load(single).astype(np.int32)
         cut = max(1, int(len(toks) * 0.95))
         return toks[:cut], toks[cut:]
+    from .tfrecord import find_tfrecords, load_token_records
+    train_recs = find_tfrecords(data_dir, "train")
+    test_recs = find_tfrecords(data_dir, "test")
+    if train_recs and test_recs:
+        return (load_token_records(train_recs),
+                load_token_records(test_recs))
+    any_recs = find_tfrecords(data_dir)
+    if any_recs:
+        toks = load_token_records(any_recs)
+        cut = max(1, int(len(toks) * 0.95))
+        return toks[:cut], toks[cut:]
     raise FileNotFoundError(
-        f"no train.npy/test.npy or tokens.npy under {data_dir!r}")
+        f"no train.npy/test.npy, tokens.npy, or *.tfrecord under "
+        f"{data_dir!r}")
 
 
 def get_bert_data(data_dir: str | None, *, vocab_size: int = 30522,

@@ -24,7 +24,7 @@ import numpy as np
 
 _DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
 _SO = os.path.join(_DIR, "libdtxdata.so")
-_ABI = 2
+_ABI = 3
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -101,6 +101,13 @@ def _load() -> ctypes.CDLL | None:
                                               ctypes.c_void_p]
         lib.dl_cifar_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
                                       ctypes.c_void_p, ctypes.c_int64]
+        lib.dl_crc32c.restype = ctypes.c_uint32
+        lib.dl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.dl_tfrecord_index.restype = ctypes.c_int64
+        lib.dl_tfrecord_index.argtypes = [ctypes.c_char_p,
+                                          ctypes.POINTER(ctypes.c_int64),
+                                          ctypes.POINTER(ctypes.c_int64),
+                                          ctypes.c_int64, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -163,6 +170,37 @@ def read_cifar_bin(path: str) -> tuple[np.ndarray, np.ndarray]:
     if rc:
         raise ValueError(f"dl_cifar_read({path!r}) -> {rc}")
     return x, y
+
+
+def crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli) via the C++ slicing-by-8 kernel."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    return int(lib.dl_crc32c(data, len(data)))
+
+
+def tfrecord_index(path: str, *, verify: bool = False
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """(data_offsets, data_lengths) int64 arrays for a TFRecord file,
+    scanned in C++ (verify additionally checks both per-record CRCs)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    n = lib.dl_tfrecord_index(path.encode(), None, None, 0,
+                              1 if verify else 0)
+    if n < 0:
+        raise ValueError(f"dl_tfrecord_index({path!r}) -> {n}")
+    offsets = np.empty(n, np.int64)
+    lengths = np.empty(n, np.int64)
+    rc = lib.dl_tfrecord_index(
+        path.encode(),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, 1 if verify else 0)
+    if rc < 0:
+        raise ValueError(f"dl_tfrecord_index({path!r}) -> {rc}")
+    return offsets[:rc], lengths[:rc]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,401 @@
+"""TFRecord container + tf.train.Example codec (the reference era's
+storage format).
+
+The reference stack's input files were TFRecords of serialized
+``tf.train.Example`` protos, written by ``tf.python_io.TFRecordWriter``
+and consumed through the queue-runner input pipeline (SURVEY.md §2.2
+'Legacy queue input'); BERT-style pretraining data ships the same way.
+This module implements both layers without a TensorFlow or protobuf
+dependency:
+
+- the record framing (u64le length | masked crc32c | data | masked
+  crc32c) with CRC-32C in C++ when the native library is available
+  (data/_native/dataloader.cpp dl_crc32c / dl_tfrecord_index) and a
+  pure-Python table fallback otherwise;
+- a hand-rolled wire-format codec for the fixed ``Example`` schema
+  (Features → map<string, Feature> → Bytes/Float/Int64List), accepting
+  both packed and unpacked repeated encodings.
+
+Format compatibility with the real TensorFlow implementations is
+asserted by oracle tests against the installed TF wheel
+(tests/test_tfrecord.py).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Iterator
+
+import numpy as np
+
+from . import native
+
+# ---------------------------------------------------------------------------
+# CRC-32C + record masking
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: np.ndarray | None = None
+
+
+def _crc_table() -> np.ndarray:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        table = np.empty(256, np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table[i] = c
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def _crc32c_py(data: bytes) -> int:
+    table = _crc_table()
+    c = 0xFFFFFFFF
+    for b in data:
+        c = (c >> 8) ^ int(table[(c ^ b) & 0xFF])
+    return c ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli). C++ slicing-by-8 when available."""
+    if native.available():
+        return native.crc32c(data)
+    return _crc32c_py(data)
+
+
+def masked_crc32c(data: bytes) -> int:
+    """The TFRecord CRC mask: rotr(crc, 15) + 0xa282ead8 (avoids CRCs of
+    CRC-bearing data looking valid)."""
+    c = crc32c(data)
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Record framing
+# ---------------------------------------------------------------------------
+
+
+class TFRecordWriter:
+    """``tf.python_io.TFRecordWriter`` parity: append framed records.
+
+    >>> with TFRecordWriter(path) as w:
+    ...     w.write(example_bytes)
+    """
+
+    def __init__(self, path: str):
+        self._f = open(path, "wb")
+
+    def write(self, record: bytes) -> None:
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc32c(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", masked_crc32c(record)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "TFRecordWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def tfrecord_iterator(path: str, *, verify: bool = False
+                      ) -> Iterator[bytes]:
+    """Stream records from a TFRecord file
+    (``tf.compat.v1.io.tf_record_iterator`` parity). ``verify`` checks
+    both per-record CRCs and raises ValueError on corruption."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) != 12:
+                raise ValueError(f"{path}: truncated record header")
+            pos += 12
+            (length,) = struct.unpack("<Q", header[:8])
+            # bound-check before read(): a corrupt huge length must be a
+            # clean ValueError, not an attempted 2^64-byte allocation
+            remaining = size - pos
+            if remaining < 4 or length > remaining - 4:
+                raise ValueError(f"{path}: truncated record data")
+            if verify:
+                (want,) = struct.unpack("<I", header[8:12])
+                if masked_crc32c(header[:8]) != want:
+                    raise ValueError(f"{path}: corrupt length crc")
+            data = f.read(length)
+            footer = f.read(4)
+            if len(data) != length or len(footer) != 4:
+                raise ValueError(f"{path}: truncated record data")
+            pos += length + 4
+            if verify:
+                (want,) = struct.unpack("<I", footer)
+                if masked_crc32c(data) != want:
+                    raise ValueError(f"{path}: corrupt data crc")
+            yield data
+
+
+class TFRecordFile:
+    """Index-backed random access over one TFRecord file.
+
+    The index (data offsets + lengths) is built by the C++ scanner when
+    the native library is available — including CRC verification off
+    the GIL — and by a Python pass otherwise.
+    """
+
+    def __init__(self, path: str, *, verify: bool = False):
+        self.path = path
+        if native.available():
+            self._offsets, self._lengths = native.tfrecord_index(
+                path, verify=verify)
+        else:
+            offs: list[int] = []
+            lens: list[int] = []
+            pos = 0
+            for rec in tfrecord_iterator(path, verify=verify):
+                offs.append(pos + 12)
+                lens.append(len(rec))
+                pos += 12 + len(rec) + 4
+            self._offsets = np.asarray(offs, np.int64)
+            self._lengths = np.asarray(lens, np.int64)
+        self._f = open(path, "rb")
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def __getitem__(self, i: int) -> bytes:
+        self._f.seek(int(self._offsets[i]))
+        return self._f.read(int(self._lengths[i]))
+
+    def __iter__(self) -> Iterator[bytes]:
+        for i in range(len(self)):
+            yield self[i]
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "TFRecordFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example wire-format codec
+# ---------------------------------------------------------------------------
+# Schema (proto3):
+#   Example  { Features features = 1; }
+#   Features { map<string, Feature> feature = 1; }
+#   Feature  { oneof kind { BytesList bytes_list = 1;
+#                           FloatList float_list = 2;
+#                           Int64List int64_list = 3; } }
+#   BytesList { repeated bytes value = 1; }
+#   FloatList { repeated float value = 1; }   // packed
+#   Int64List { repeated int64 value = 1; }   // packed
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    """Length-delimited field (wire type 2)."""
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def encode_example(features: dict[str, Any]) -> bytes:
+    """Serialize a feature dict to ``tf.train.Example`` bytes.
+
+    Value typing follows tf conventions: bytes/str → BytesList,
+    float arrays → FloatList, int arrays → Int64List. Map entries are
+    emitted in sorted key order (any order parses back identically).
+    """
+    feats = bytearray()
+    for key in sorted(features):
+        val = features[key]
+        if isinstance(val, (bytes, str)):
+            val = [val]
+        arr = val if isinstance(val, (list, tuple)) else np.asarray(val)
+        if isinstance(arr, (list, tuple)) and arr and isinstance(
+                arr[0], (bytes, str)):
+            items = b"".join(
+                _ld(1, v.encode() if isinstance(v, str) else v)
+                for v in arr)
+            feature = _ld(1, items)                       # bytes_list
+        else:
+            a = np.asarray(arr)
+            if a.dtype.kind == "f":
+                packed = a.astype("<f4").tobytes()
+                feature = _ld(2, _ld(1, packed))          # float_list
+            elif a.dtype.kind in "iu":
+                packed = b"".join(
+                    _varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                    for v in a.reshape(-1))
+                feature = _ld(3, _ld(1, packed))          # int64_list
+            else:
+                raise TypeError(
+                    f"unsupported feature dtype for {key!r}: {a.dtype}")
+        entry = _ld(1, key.encode()) + _ld(2, feature)    # map entry
+        feats += _ld(1, entry)
+    return bytes(_ld(1, bytes(feats)))                    # Example.features
+
+
+def _parse_fields(buf: bytes) -> Iterator[tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over a message buffer."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        elif wt == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _to_int64(u: int) -> int:
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
+def _decode_feature(buf: bytes) -> Any:
+    for field, wt, v in _parse_fields(buf):
+        if field == 1 and wt == 2:                        # BytesList
+            return [bv for f2, w2, bv in _parse_fields(v)
+                    if f2 == 1 and w2 == 2]
+        if field == 2:                                    # FloatList
+            out: list[float] = []
+            for f2, w2, fv in _parse_fields(v):
+                if f2 != 1:
+                    continue
+                if w2 == 2:                               # packed
+                    out.extend(np.frombuffer(fv, "<f4").tolist())
+                elif w2 == 5:                             # unpacked
+                    out.append(struct.unpack("<f", fv)[0])
+            return np.asarray(out, np.float32)
+        if field == 3:                                    # Int64List
+            ints: list[int] = []
+            for f2, w2, iv in _parse_fields(v):
+                if f2 != 1:
+                    continue
+                if w2 == 2:                               # packed
+                    pos = 0
+                    while pos < len(iv):
+                        u, pos = _read_varint(iv, pos)
+                        ints.append(_to_int64(u))
+                elif w2 == 0:                             # unpacked
+                    ints.append(_to_int64(iv))
+            return np.asarray(ints, np.int64)
+    return None
+
+
+def decode_example(data: bytes) -> dict[str, Any]:
+    """Parse ``tf.train.Example`` bytes into {name: value}: BytesList →
+    list[bytes], FloatList → f32 array, Int64List → i64 array."""
+    out: dict[str, Any] = {}
+    for field, wt, v in _parse_fields(data):
+        if field != 1 or wt != 2:
+            continue                                      # Example.features
+        for f2, w2, entry in _parse_fields(v):
+            if f2 != 1 or w2 != 2:
+                continue                                  # map entry
+            key = None
+            val = None
+            for f3, w3, ev in _parse_fields(entry):
+                if f3 == 1 and w3 == 2:
+                    key = ev.decode()
+                elif f3 == 2 and w3 == 2:
+                    val = _decode_feature(ev)
+            if key is not None:
+                out[key] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dataset-level helpers
+# ---------------------------------------------------------------------------
+
+
+def write_examples(path: str, examples: "list[dict[str, Any]]") -> None:
+    """Write a list of feature dicts as one TFRecord file of Examples."""
+    with TFRecordWriter(path) as w:
+        for ex in examples:
+            w.write(encode_example(ex))
+
+
+def load_token_records(paths: "list[str]", feature: str = "input_ids",
+                       *, verify: bool = False) -> np.ndarray:
+    """[N, S] int32 token matrix from TFRecords of Examples — the BERT
+    pretraining data format (create_pretraining_data-style files). All
+    records must carry ``feature`` with one fixed length."""
+    rows: list[np.ndarray] = []
+    for path in sorted(paths):
+        for rec in tfrecord_iterator(path, verify=verify):
+            ex = decode_example(rec)
+            if feature not in ex:
+                raise ValueError(
+                    f"{path}: record without {feature!r} feature "
+                    f"(has {sorted(ex)})")
+            rows.append(np.asarray(ex[feature], np.int32))
+    if not rows:
+        raise ValueError(f"no records in {paths}")
+    lens = {len(r) for r in rows}
+    if len(lens) != 1:
+        raise ValueError(
+            f"records disagree on {feature!r} length: {sorted(lens)}")
+    return np.stack(rows)
+
+
+def find_tfrecords(data_dir: str, prefix: str = "") -> "list[str]":
+    """All ``{prefix}*.tfrecord`` files under data_dir, sorted."""
+    try:
+        names = sorted(os.listdir(data_dir))
+    except OSError:
+        return []
+    return [os.path.join(data_dir, n) for n in names
+            if n.startswith(prefix) and n.endswith(".tfrecord")]

@@ -25,29 +25,9 @@ import socket
 import struct
 import time
 
-# ---------------------------------------------------------------------------
-# crc32c (Castagnoli), table-driven — required by TFRecord framing
-# ---------------------------------------------------------------------------
-
-_CRC_TABLE = []
-_POLY = 0x82F63B78
-for _i in range(256):
-    _c = _i
-    for _ in range(8):
-        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
-    _CRC_TABLE.append(_c)
-
-
-def _crc32c(data: bytes) -> int:
-    crc = 0xFFFFFFFF
-    for b in data:
-        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
-def _masked_crc(data: bytes) -> int:
-    crc = _crc32c(data)
-    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+# the TFRecord framing CRC lives with the container format (one
+# implementation, native-accelerated when the C++ library is built)
+from ..data.tfrecord import masked_crc32c as _masked_crc
 
 
 # ---------------------------------------------------------------------------

@@ -6,17 +6,18 @@ import glob
 import numpy as np
 import pytest
 
+from distributed_tensorflow_example_tpu.data.tfrecord import crc32c
 from distributed_tensorflow_example_tpu.utils.metrics import MetricsLogger
 from distributed_tensorflow_example_tpu.utils.tb_events import (
-    EventFileWriter, _crc32c, _masked_crc)
+    EventFileWriter, _masked_crc)
 
 
 def test_crc32c_known_vectors():
-    # RFC 3720 test vectors
-    assert _crc32c(b"") == 0x0
-    assert _crc32c(b"123456789") == 0xE3069283
-    assert _crc32c(bytes(32)) == 0x8A9136AA
-    assert _masked_crc(b"123456789") != _crc32c(b"123456789")
+    # RFC 3720 test vectors (one shared CRC impl with data/tfrecord.py)
+    assert crc32c(b"") == 0x0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+    assert _masked_crc(b"123456789") != crc32c(b"123456789")
 
 
 def test_roundtrip_against_tensorflow_reader(tmp_path):

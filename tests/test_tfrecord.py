@@ -1,0 +1,230 @@
+"""TFRecord container + tf.train.Example codec.
+
+Format compatibility is the point: records we write must parse with the
+real TensorFlow readers and vice versa (the installed TF wheel is the
+oracle — SURVEY.md §0 [TF]), and the C++ scanner must agree with the
+pure-Python path byte for byte.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.data import native
+from distributed_tensorflow_example_tpu.data.tfrecord import (
+    TFRecordFile, TFRecordWriter, _crc32c_py, crc32c, decode_example,
+    encode_example, find_tfrecords, load_token_records, masked_crc32c,
+    tfrecord_iterator, write_examples)
+
+
+# -- CRC-32C ---------------------------------------------------------------
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / kats: crc32c("123456789") = 0xE3069283
+    assert _crc32c_py(b"123456789") == 0xE3069283
+    assert _crc32c_py(b"") == 0
+    # 32 bytes of zeros: 0x8A9136AA (iSCSI test vector)
+    assert _crc32c_py(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_crc32c_native_matches_python():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rs = np.random.RandomState(0)
+    for n in (0, 1, 7, 8, 9, 63, 64, 1000, 4097):
+        data = rs.bytes(n)
+        assert native.crc32c(data) == _crc32c_py(data), n
+
+
+# -- framing ---------------------------------------------------------------
+
+def test_roundtrip_writer_iterator(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = [b"hello", b"", b"x" * 1000, bytes(range(256))]
+    with TFRecordWriter(path) as w:
+        for r in recs:
+            w.write(r)
+    assert list(tfrecord_iterator(path, verify=True)) == recs
+
+
+def test_random_access_file(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = [f"record-{i}".encode() * (i + 1) for i in range(20)]
+    with TFRecordWriter(path) as w:
+        for r in recs:
+            w.write(r)
+    with TFRecordFile(path, verify=True) as f:
+        assert len(f) == 20
+        assert f[7] == recs[7]
+        assert f[0] == recs[0]
+        assert list(f) == recs
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / "bad.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"payload-bytes-here")
+    raw = bytearray(open(path, "rb").read())
+    raw[14] ^= 0xFF                       # flip a data byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        list(tfrecord_iterator(path, verify=True))
+    # unverified iteration still frames correctly
+    assert len(list(tfrecord_iterator(path))) == 1
+    if native.available():
+        with pytest.raises(ValueError):
+            native.tfrecord_index(path, verify=True)
+
+
+def test_truncation_detected(tmp_path):
+    path = str(tmp_path / "trunc.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"0123456789" * 10)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-6])
+    with pytest.raises(ValueError):
+        list(tfrecord_iterator(path))
+    if native.available():
+        with pytest.raises(ValueError):
+            native.tfrecord_index(path)
+
+
+def test_corrupt_highbit_length_rejected(tmp_path):
+    """A length field with the high bit set must error (-4 / ValueError),
+    not wrap negative in the bounds check and loop or misparse."""
+    path = str(tmp_path / "evil.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"ok-record")
+    raw = bytearray(open(path, "rb").read())
+    struct.pack_into("<Q", raw, 0, 0xFFFFFFFFFFFFFFF0)
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError):
+        list(tfrecord_iterator(path))
+    if native.available():
+        with pytest.raises(ValueError):
+            native.tfrecord_index(path)
+        with pytest.raises(ValueError):
+            native.tfrecord_index(path, verify=True)
+
+
+def test_native_index_matches_python(tmp_path):
+    if not native.available():
+        pytest.skip("native library unavailable")
+    path = str(tmp_path / "a.tfrecord")
+    recs = [bytes([i]) * (13 * i + 1) for i in range(17)]
+    with TFRecordWriter(path) as w:
+        for r in recs:
+            w.write(r)
+    offsets, lengths = native.tfrecord_index(path, verify=True)
+    assert list(lengths) == [len(r) for r in recs]
+    # offsets point at the data: reread by hand
+    raw = open(path, "rb").read()
+    for off, ln, rec in zip(offsets, lengths, recs):
+        assert raw[off:off + ln] == rec
+
+
+# -- Example codec ---------------------------------------------------------
+
+def test_example_roundtrip():
+    ex = {
+        "input_ids": np.arange(16, dtype=np.int64),
+        "weights": np.linspace(0, 1, 5).astype(np.float32),
+        "name": [b"abc", b"def"],
+        "negative": np.asarray([-1, -(2 ** 40)], np.int64),
+    }
+    out = decode_example(encode_example(ex))
+    np.testing.assert_array_equal(out["input_ids"], ex["input_ids"])
+    np.testing.assert_allclose(out["weights"], ex["weights"], rtol=1e-6)
+    assert out["name"] == [b"abc", b"def"]
+    np.testing.assert_array_equal(out["negative"], ex["negative"])
+
+
+# -- TF-wheel oracle -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tf():
+    return pytest.importorskip("tensorflow")
+
+
+def test_tf_reads_our_records(tmp_path, tf):
+    path = str(tmp_path / "ours.tfrecord")
+    write_examples(path, [
+        {"input_ids": np.arange(8, dtype=np.int64), "score": [0.5, -2.0]},
+        {"input_ids": np.asarray([5, -6, 7], np.int64),
+         "tag": [b"oracle"]},
+    ])
+    got = []
+    for raw in tf.compat.v1.io.tf_record_iterator(path):
+        e = tf.train.Example()
+        e.ParseFromString(raw)
+        got.append(e)
+    assert len(got) == 2
+    assert list(got[0].features.feature["input_ids"].int64_list.value) \
+        == list(range(8))
+    np.testing.assert_allclose(
+        list(got[0].features.feature["score"].float_list.value),
+        [0.5, -2.0], rtol=1e-6)
+    assert list(got[1].features.feature["input_ids"].int64_list.value) \
+        == [5, -6, 7]
+    assert got[1].features.feature["tag"].bytes_list.value[0] == b"oracle"
+
+
+def test_we_read_tf_records(tmp_path, tf):
+    path = str(tmp_path / "theirs.tfrecord")
+    with tf.io.TFRecordWriter(path) as w:
+        for i in range(3):
+            e = tf.train.Example(features=tf.train.Features(feature={
+                "input_ids": tf.train.Feature(int64_list=tf.train.Int64List(
+                    value=list(range(i, i + 4)))),
+                "f": tf.train.Feature(float_list=tf.train.FloatList(
+                    value=[float(i), 0.25])),
+                "b": tf.train.Feature(bytes_list=tf.train.BytesList(
+                    value=[b"x" * (i + 1)])),
+            }))
+            w.write(e.SerializeToString())
+    recs = list(tfrecord_iterator(path, verify=True))
+    assert len(recs) == 3
+    for i, raw in enumerate(recs):
+        ex = decode_example(raw)
+        np.testing.assert_array_equal(ex["input_ids"],
+                                      np.arange(i, i + 4))
+        np.testing.assert_allclose(ex["f"], [float(i), 0.25], rtol=1e-6)
+        assert ex["b"] == [b"x" * (i + 1)]
+    # and the indexer agrees with TF's framing
+    with TFRecordFile(path, verify=True) as f:
+        assert len(f) == 3
+
+
+# -- BERT data-path integration --------------------------------------------
+
+def test_bert_loads_tfrecord_dir(tmp_path):
+    from distributed_tensorflow_example_tpu.data.bert_data import (
+        get_bert_data, load_tokenized)
+
+    rs = np.random.RandomState(0)
+    train = rs.randint(110, 1000, size=(32, 64)).astype(np.int64)
+    test = rs.randint(110, 1000, size=(8, 64)).astype(np.int64)
+    write_examples(str(tmp_path / "train-00000.tfrecord"),
+                   [{"input_ids": row} for row in train[:16]])
+    write_examples(str(tmp_path / "train-00001.tfrecord"),
+                   [{"input_ids": row} for row in train[16:]])
+    write_examples(str(tmp_path / "test-00000.tfrecord"),
+                   [{"input_ids": row} for row in test])
+    tr, te = load_tokenized(str(tmp_path))
+    np.testing.assert_array_equal(tr, train.astype(np.int32))
+    np.testing.assert_array_equal(te, test.astype(np.int32))
+
+    batches, _ = get_bert_data(str(tmp_path), seq_len=64, vocab_size=1000)
+    assert batches["input_ids"].shape == (32, 64)
+
+
+def test_load_token_records_validates(tmp_path):
+    write_examples(str(tmp_path / "a.tfrecord"),
+                   [{"input_ids": np.arange(4, dtype=np.int64)},
+                    {"input_ids": np.arange(5, dtype=np.int64)}])
+    with pytest.raises(ValueError, match="length"):
+        load_token_records(find_tfrecords(str(tmp_path)))
+    write_examples(str(tmp_path / "b.tfrecord"), [{"other": [1, 2]}])
+    with pytest.raises(ValueError, match="input_ids"):
+        load_token_records([str(tmp_path / "b.tfrecord")])
