@@ -1,0 +1,193 @@
+"""Warm-start: initialize part of a fresh model from a checkpoint.
+
+``tf.train.init_from_checkpoint`` parity (the fine-tuning entry of the
+reference era: load a pretrained encoder, keep the fresh head — the
+``assignment_map`` scope-mapping contract), built on this repo's
+checkpoint format instead of graph init ops. Unlike
+``CheckpointManager.restore`` — which is resume (exact tree, step and
+optimizer state included) — warm start touches ONLY the parameters the
+map selects: the step stays 0, the optimizer state stays fresh, missing
+leaves keep their fresh init, and a shape mismatch is a hard error
+(same contract as init_from_checkpoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any
+
+import jax
+import ml_dtypes
+import numpy as np
+
+from ..utils.pytree import is_prng_key as _is_key, path_str as _path_str
+from .checkpoint import (PREFIX, STATE_FILE, _leaf_from_pieces,
+                         _merge_metas)
+
+PyTree = Any
+
+
+def load_checkpoint_arrays(ckpt: str, step: int | None = None
+                           ) -> dict[str, np.ndarray]:
+    """Flat {path: array} from a checkpoint — a ``ckpt-N.npz`` file or a
+    checkpoint directory (latest step by default). Handles both on-disk
+    formats (monolithic npz and sharded anchors) and restores bf16
+    leaves to their real dtype. PRNG-key leaves are omitted (warm start
+    never transplants random streams)."""
+    if os.path.isfile(ckpt):
+        path = ckpt
+    else:
+        state_file = os.path.join(ckpt, STATE_FILE)
+        if step is None:
+            if not os.path.exists(state_file):
+                raise FileNotFoundError(
+                    f"no '{STATE_FILE}' state file under {ckpt!r}")
+            with open(state_file) as f:
+                latest = json.load(f).get("latest")
+            if latest is None:
+                raise FileNotFoundError(f"no checkpoint under {ckpt!r}")
+            path = os.path.join(ckpt, latest)
+        else:
+            path = os.path.join(ckpt, f"{PREFIX}-{step}.npz")
+            if not os.path.exists(path):
+                path = os.path.join(ckpt, f"{PREFIX}-{step}.shards.json")
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"no checkpoint at step {step} under {ckpt!r}")
+
+    if path.endswith(".shards.json"):
+        with open(path) as f:
+            anchor = json.load(f)
+        directory = os.path.dirname(path)
+        loads = {os.path.join(directory, b): np.load(
+            os.path.join(directory, b)) for b in anchor["files"]}
+        try:
+            out = {}
+            for key, entry in _merge_metas(loads).items():
+                if entry["kind"] == "prngkey":
+                    continue
+                out[key] = np.asarray(_leaf_from_pieces(entry, loads))
+            return out
+        finally:
+            for z in loads.values():
+                z.close()
+
+    with np.load(path) as z:
+        out = {}
+        for key in z.files:
+            if key.startswith(("__prngkey__/", "__prngimpl__/",
+                               "__shardmeta__")):
+                continue
+            if key.startswith("__bf16__/"):
+                out[key[len("__bf16__/"):]] = \
+                    z[key].view(ml_dtypes.bfloat16)
+            else:
+                out[key] = z[key]
+        return out
+
+
+@dataclasses.dataclass
+class WarmStartReport:
+    """What the map matched: ``restored`` params came from the
+    checkpoint, ``fresh`` kept their initializer (no checkpoint key)."""
+
+    restored: list[str]
+    fresh: list[str]
+
+    def __str__(self) -> str:
+        return (f"warm-start: {len(self.restored)} restored, "
+                f"{len(self.fresh)} fresh")
+
+
+def warm_start(params: PyTree, ckpt: str,
+               assignment_map: "dict[str, str] | None" = None, *,
+               step: int | None = None, require_all: bool = False,
+               ckpt_scope: str = "params"
+               ) -> tuple[PyTree, WarmStartReport]:
+    """Replace matching leaves of a freshly-initialized ``params`` tree
+    with values from ``ckpt``.
+
+    ``assignment_map`` maps checkpoint scopes to model scopes, exactly
+    like ``tf.train.init_from_checkpoint``: ``{"encoder/": "enc/"}``
+    loads checkpoint key ``encoder/X`` into model path ``enc/X``; the
+    default ``{"": ""}`` matches identical paths. Entries apply
+    independently (tf semantics): each is tried in insertion order and
+    the first that RESOLVES to a checkpoint key wins — so
+    ``{"bert/": "", "cls/": ""}`` restores both scopes even though
+    every path prefix-matches the first entry.
+
+    Values are cast to the target leaf's dtype and placed on its
+    sharding. Shape mismatch → ValueError. A model path with no
+    checkpoint key keeps its fresh value (ValueError instead when
+    ``require_all``).
+    """
+    if assignment_map is None:
+        assignment_map = {"": ""}
+    arrays = load_checkpoint_arrays(ckpt, step=step)
+    scope = ckpt_scope + "/" if ckpt_scope else ""
+    available = {k[len(scope):]: v for k, v in arrays.items()
+                 if k.startswith(scope)}
+    if not available:
+        raise ValueError(
+            f"checkpoint {ckpt!r} holds no {ckpt_scope!r} leaves "
+            f"(keys: {sorted(arrays)[:8]}...)")
+
+    restored: list[str] = []
+    fresh: list[str] = []
+
+    def lookup(path: str) -> np.ndarray | None:
+        for ck_prefix, model_prefix in assignment_map.items():
+            if path.startswith(model_prefix):
+                key = ck_prefix + path[len(model_prefix):]
+                if key in available:
+                    return available[key]
+                # entries apply independently: keep trying later ones
+        return None
+
+    def replace(path_tuple, leaf):
+        path = _path_str(path_tuple)
+        if _is_key(leaf):
+            return leaf
+        value = lookup(path)
+        if value is None:
+            fresh.append(path)
+            if require_all:
+                raise ValueError(
+                    f"warm start: no checkpoint value for {path!r} "
+                    "(require_all=True)")
+            return leaf
+        if tuple(value.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"warm start: shape mismatch for {path!r}: checkpoint "
+                f"{tuple(value.shape)} vs model {tuple(np.shape(leaf))}")
+        restored.append(path)
+        value = value.astype(
+            getattr(leaf, "dtype", value.dtype))
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            return jax.device_put(value, leaf.sharding)
+        return jax.numpy.asarray(value)
+
+    new_params = jax.tree_util.tree_map_with_path(replace, params)
+    return new_params, WarmStartReport(restored=restored, fresh=fresh)
+
+
+def parse_assignment_map(spec: str) -> "dict[str, str] | None":
+    """CLI form: ``ckpt_prefix:model_prefix`` pairs, comma-separated
+    (``bert/encoder/:encoder/``). Empty string → None (identity map)."""
+    spec = spec.strip()
+    if not spec:
+        return None
+    out: dict[str, str] = {}
+    for pair in spec.split(","):
+        if ":" not in pair:
+            raise ValueError(
+                f"bad --warm_start_map entry {pair!r} "
+                "(want ckpt_prefix:model_prefix)")
+        ck, model = pair.split(":", 1)
+        if not re.fullmatch(r"[\w/.\-]*", ck + model):
+            raise ValueError(f"bad --warm_start_map entry {pair!r}")
+        out[ck] = model
+    return out

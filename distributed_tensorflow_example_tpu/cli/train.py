@@ -108,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "recipe uses 0.1)")
     p.add_argument("--grad_clip_norm", type=float, default=0.0,
                    help="global-norm gradient clipping (0 disables)")
+    p.add_argument("--warm_start", default=None,
+                   help="checkpoint file/dir to initialize params from "
+                        "when starting fresh (tf.train.init_from_"
+                        "checkpoint parity; a checkpoint in --ckpt_dir "
+                        "always wins)")
+    p.add_argument("--warm_start_map", default="",
+                   help="assignment map 'ckpt_prefix:model_prefix' "
+                        "pairs, comma-separated (default: same paths)")
     p.add_argument("--ema_decay", type=float, default=0.0,
                    help="shadow-param EMA decay "
                         "(tf.train.ExponentialMovingAverage parity; "
@@ -261,6 +269,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         sync=SyncConfig(accum_steps=args.accum_steps, mode=args.sync_mode),
         checkpoint=CheckpointConfig(
             directory=args.ckpt_dir,
+            warm_start=args.warm_start,
+            warm_start_map=args.warm_start_map,
             max_to_keep=args.max_to_keep,
             save_steps=args.save_steps,
             save_secs=args.save_secs,

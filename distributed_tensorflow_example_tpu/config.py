@@ -142,6 +142,13 @@ class CheckpointConfig:
     'checkpoint' state file, restore-or-init."""
 
     directory: str | None = None
+    warm_start: str | None = None   # checkpoint file/dir to initialize
+                                    # params from when no checkpoint
+                                    # exists in `directory`
+                                    # (tf.train.init_from_checkpoint
+                                    # parity; resume always wins)
+    warm_start_map: str = ""        # 'ckpt_prefix:model_prefix' pairs,
+                                    # comma-separated (assignment_map)
     max_to_keep: int = 5
     save_steps: int = 0             # save every N steps (0 disables step-based)
     save_secs: float = 0.0          # save every T seconds (0 disables time-based)

@@ -176,6 +176,23 @@ def params_ema(decay: float, debias: bool = False
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def reset_ema(opt_state: Any, params: Any) -> Any:
+    """Re-anchor every EMA shadow in ``opt_state`` to ``params`` (count
+    reset to 0). Needed when params are replaced outside the optimizer —
+    warm start — since the shadow snapshotted the discarded init (tf
+    rewrote initializers BEFORE ema.apply snapshotted them)."""
+    fresh = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32),
+                                   params)
+
+    def fix(x):
+        if isinstance(x, EmaState):
+            return EmaState(jnp.zeros((), jnp.int32), fresh)
+        return x
+
+    return jax.tree_util.tree_map(
+        fix, opt_state, is_leaf=lambda x: isinstance(x, EmaState))
+
+
 def find_ema_params(opt_state: Any) -> Any | None:
     """Pull the shadow-param tree out of an optimizer state, traversing
     wrappers (MultiSteps, chain tuples). None when EMA is not enabled —

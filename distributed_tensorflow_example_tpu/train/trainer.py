@@ -166,6 +166,25 @@ class Trainer:
         else:
             log.info("initialized fresh state: %d params",
                      param_count(state.params))
+            if self.config.checkpoint.warm_start:
+                # init_from_checkpoint parity: params only, on a fresh
+                # init — a checkpoint in OUR directory means resume, and
+                # resume always wins over warm start
+                from ..ckpt.warm_start import (parse_assignment_map,
+                                               warm_start)
+                from .optimizers import reset_ema
+                params, report = warm_start(
+                    state.params, self.config.checkpoint.warm_start,
+                    parse_assignment_map(
+                        self.config.checkpoint.warm_start_map))
+                # re-anchor any EMA shadow: it snapshotted the discarded
+                # fresh init at sync.init time
+                state = state.replace(
+                    params=params,
+                    opt_state=reset_ema(state.opt_state, params))
+                self.state = state
+                log.info("%s (from %s)", report,
+                         self.config.checkpoint.warm_start)
         return state
 
     def _loader(self) -> Iterator[dict[str, np.ndarray]]:

@@ -1,0 +1,254 @@
+"""Warm start / init_from_checkpoint parity (ckpt/warm_start.py).
+
+Contract under test (mirrors tf.train.init_from_checkpoint): params the
+assignment map selects come from the checkpoint; everything else keeps
+its fresh init; step and optimizer state stay fresh; shape mismatch is
+a hard error; resume (a checkpoint in the run's own dir) beats warm
+start.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+    CheckpointManager)
+from distributed_tensorflow_example_tpu.ckpt.warm_start import (
+    load_checkpoint_arrays, parse_assignment_map, warm_start)
+from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                       DataConfig,
+                                                       OptimizerConfig,
+                                                       TrainConfig)
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.train.optimizers import (
+    make_optimizer)
+
+
+def _trained_mlp_ckpt(tmp_path, steps=3):
+    cfg = TrainConfig(model="mlp",
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.1))
+    m = get_model("mlp", cfg)
+    mesh = local_mesh(1, {"data": 1})
+    sync = SyncReplicas(m.loss, make_optimizer(cfg.optimizer), mesh)
+    state = sync.init(m.init)
+    batch = m.dummy_batch(16)
+    for _ in range(steps):
+        state, _ = sync.step(state, batch)
+    mgr = CheckpointManager(str(tmp_path / "pretrained"))
+    mgr.save(state, step=steps)
+    return state, str(tmp_path / "pretrained"), (m, sync)
+
+
+def test_identity_warm_start(tmp_path):
+    src_state, ckpt_dir, (m, sync) = _trained_mlp_ckpt(tmp_path)
+    fresh = sync.init(m.init, seed=123)   # different init than src
+    warmed, report = warm_start(fresh.params, ckpt_dir)
+    assert not report.fresh
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        warmed, src_state.params)
+    # the fresh state itself is untouched: step/opt state stay fresh
+    assert int(fresh.step) == 0
+
+
+def test_missing_leaves_stay_fresh(tmp_path):
+    _, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    arrays = load_checkpoint_arrays(ckpt_dir)
+    some_key = sorted(k for k in arrays if k.startswith("params/"))[0]
+    target_path = some_key[len("params/"):]
+    # model tree: one path present in the ckpt, one new head
+    params = {
+        target_path.split("/")[0]: {
+            target_path.split("/")[1]:
+                jnp.zeros(arrays[some_key].shape,
+                          arrays[some_key].dtype)},
+        "new_head": {"kernel": jnp.ones((4, 2))},
+    }
+    warmed, report = warm_start(params, ckpt_dir)
+    assert any(p.startswith("new_head") for p in report.fresh)
+    np.testing.assert_array_equal(
+        np.asarray(warmed["new_head"]["kernel"]), np.ones((4, 2)))
+    np.testing.assert_array_equal(
+        np.asarray(warmed[target_path.split("/")[0]]
+                   [target_path.split("/")[1]]),
+        arrays[some_key])
+    with pytest.raises(ValueError, match="require_all"):
+        warm_start(params, ckpt_dir, require_all=True)
+
+
+def test_assignment_map_renames_scope(tmp_path):
+    src_state, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    flat = load_checkpoint_arrays(ckpt_dir)
+    src_keys = sorted(k[len("params/"):] for k in flat
+                      if k.startswith("params/"))
+    # re-scope the model tree under 'student/'
+    params = {"student": jax.tree_util.tree_map(
+        lambda x: jnp.zeros_like(x), src_state.params)}
+    warmed, report = warm_start(params, ckpt_dir,
+                                assignment_map={"": "student/"})
+    assert sorted(p[len("student/"):] for p in report.restored) \
+        == src_keys
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        warmed["student"], src_state.params)
+
+
+def test_shape_mismatch_raises(tmp_path):
+    _, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    arrays = load_checkpoint_arrays(ckpt_dir)
+    key = sorted(k for k in arrays if k.startswith("params/"))[0]
+    path = key[len("params/"):]
+    a, b = path.split("/")
+    params = {a: {b: jnp.zeros((3, 3))}}    # wrong shape
+    with pytest.raises(ValueError, match="shape mismatch"):
+        warm_start(params, ckpt_dir)
+
+
+def test_bf16_checkpoint_leaves(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    from distributed_tensorflow_example_tpu.train.state import TrainState
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params={"w": jnp.full((4,), 1.5, jnp.bfloat16)},
+                       opt_state={}, extras={},
+                       rng=jax.random.key(0))
+    mgr.save(state, step=1)
+    arrays = load_checkpoint_arrays(str(tmp_path / "c"))
+    assert arrays["params/w"].dtype == jnp.bfloat16
+    warmed, _ = warm_start({"w": jnp.zeros((4,), jnp.bfloat16)},
+                           str(tmp_path / "c"))
+    np.testing.assert_array_equal(np.asarray(warmed["w"], np.float32),
+                                  np.full((4,), 1.5, np.float32))
+
+
+def test_sharded_checkpoint_warm_start(tmp_path):
+    cfg = TrainConfig(model="mlp")
+    m = get_model("mlp", cfg)
+    mesh = local_mesh(1, {"data": 1})
+    sync = SyncReplicas(m.loss, make_optimizer(OptimizerConfig()), mesh)
+    state = sync.init(m.init)
+    mgr = CheckpointManager(str(tmp_path / "sh"), sharded=True)
+    mgr.save(state, step=5)
+    warmed, report = warm_start(sync.init(m.init, seed=9).params,
+                                str(tmp_path / "sh"))
+    assert not report.fresh
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        warmed, state.params)
+
+
+def test_trainer_warm_start_and_resume_priority(tmp_path):
+    from distributed_tensorflow_example_tpu.data.mnist import (
+        synthetic_mnist)
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    src_state, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    data = synthetic_mnist(512, 128)
+    arrays = {"x": data["train_x"], "y": data["train_y"]}
+
+    run_dir = str(tmp_path / "run")
+    cfg = TrainConfig(model="mlp", train_steps=2, seed=7,
+                      data=DataConfig(batch_size=64),
+                      checkpoint=CheckpointConfig(directory=run_dir,
+                                                  warm_start=ckpt_dir,
+                                                  save_steps=2))
+    model = get_model("mlp", cfg)
+    tr = Trainer(model, cfg, arrays, mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    state0 = tr.initialize()
+    assert int(state0.step) == 0            # warm start is not resume
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        state0.params, src_state.params)
+    state, _ = tr.train()
+    tr.close()
+
+    # second run: own checkpoint exists now -> resume wins, params are
+    # the TRAINED ones, not re-warm-started
+    cfg2 = cfg.replace(train_steps=2)
+    tr2 = Trainer(get_model("mlp", cfg2), cfg2, arrays,
+                  mesh=local_mesh(1, {"data": 1}),
+                  process_index=0, num_processes=1)
+    state2 = tr2.initialize()
+    assert int(state2.step) == 2
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        state2.params, state.params)
+    tr2.close()
+
+
+def test_overlapping_map_entries_apply_independently(tmp_path):
+    """tf semantics: {'a/': '', 'b/': ''} restores BOTH scopes even
+    though every model path prefix-matches the first entry."""
+    from distributed_tensorflow_example_tpu.train.state import TrainState
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params={"a": {"x": jnp.full((2,), 1.0)},
+                               "b": {"y": jnp.full((2,), 2.0)}},
+                       opt_state={}, extras={},
+                       rng=jax.random.key(0))
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    mgr.save(state, step=1)
+    # model tree drops the top-level scopes entirely
+    params = {"x": jnp.zeros((2,)), "y": jnp.zeros((2,))}
+    warmed, report = warm_start(params, str(tmp_path / "c"),
+                                assignment_map={"a/": "", "b/": ""})
+    assert not report.fresh
+    np.testing.assert_array_equal(np.asarray(warmed["x"]), [1.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(warmed["y"]), [2.0, 2.0])
+
+
+def test_missing_step_clean_error(tmp_path):
+    _, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    with pytest.raises(FileNotFoundError, match="step 99"):
+        load_checkpoint_arrays(ckpt_dir, step=99)
+
+
+def test_warm_start_reanchors_ema_shadow(tmp_path):
+    """The EMA shadow snapshots params at sync.init time — warm start
+    must re-anchor it to the warmed params, or eval-on-shadow would be
+    ~random-init for 1/(1-decay) steps."""
+    from distributed_tensorflow_example_tpu.data.mnist import (
+        synthetic_mnist)
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        find_ema_params)
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    src_state, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    data = synthetic_mnist(256, 64)
+    cfg = TrainConfig(model="mlp", train_steps=1, seed=11,
+                      data=DataConfig(batch_size=64),
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.1,
+                                                ema_decay=0.999),
+                      checkpoint=CheckpointConfig(
+                          directory=str(tmp_path / "r"),
+                          warm_start=ckpt_dir))
+    tr = Trainer(get_model("mlp", cfg), cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    state = tr.initialize()
+    shadow = find_ema_params(state.opt_state)
+    jax.tree_util.tree_map(
+        lambda e, p: np.testing.assert_array_equal(
+            np.asarray(e), np.asarray(p, np.float32)),
+        shadow, src_state.params)
+    tr.close()
+
+
+def test_parse_assignment_map():
+    assert parse_assignment_map("") is None
+    assert parse_assignment_map("a/:b/") == {"a/": "b/"}
+    assert parse_assignment_map("enc/:dec/,:") == {"enc/": "dec/",
+                                                   "": ""}
+    with pytest.raises(ValueError, match="warm_start_map"):
+        parse_assignment_map("no-colon-here")
