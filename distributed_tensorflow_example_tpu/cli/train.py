@@ -108,6 +108,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "recipe uses 0.1)")
     p.add_argument("--grad_clip_norm", type=float, default=0.0,
                    help="global-norm gradient clipping (0 disables)")
+    p.add_argument("--export_dir", default=None,
+                   help="write a serving artifact (StableHLO via "
+                        "jax.export, params baked in, batch-polymorphic) "
+                        "after training — the SavedModel-parity path")
     p.add_argument("--warm_start", default=None,
                    help="checkpoint file/dir to initialize params from "
                         "when starting fresh (tf.train.init_from_"
@@ -427,6 +431,15 @@ def main(argv: list[str] | None = None) -> int:
         # fail fast: everything below (dataset load, mesh, Trainer) can
         # take minutes for the big datasets
         raise SystemExit("--eval_only requires --ckpt_dir")
+    if args.export_dir:
+        # fail fast on an unwritable export target too — discovering a
+        # PermissionError AFTER a multi-hour run wastes the whole run
+        try:
+            os.makedirs(args.export_dir, exist_ok=True)
+            if not os.access(args.export_dir, os.W_OK):
+                raise PermissionError(args.export_dir)
+        except OSError as e:
+            raise SystemExit(f"--export_dir is not writable: {e}")
     if args.label_smoothing and args.model not in ("lenet", "resnet20",
                                                    "resnet50"):
         # a silently ignored training knob is worse than an error
@@ -497,6 +510,9 @@ def main(argv: list[str] | None = None) -> int:
         print(_json.dumps({"step": int(jax.device_get(state.step)),
                            **{k: round(float(v), 6)
                               for k, v in metrics.items()}}), flush=True)
+        # export-from-checkpoint: the natural serving path (restore,
+        # optionally eval, ship the artifact)
+        _maybe_export(args, cfg, model, state, ctx)
         return 0
 
     with trainer:
@@ -509,7 +525,28 @@ def main(argv: list[str] | None = None) -> int:
     log.info("done: step=%d wall=%.1fs steps/sec=%.2f",
              summary["final_step"], summary["wall_time_sec"],
              summary["steps_per_sec"])
+
+    _maybe_export(args, cfg, model, state, ctx)
     return 0
+
+
+def _maybe_export(args, cfg, model, state, ctx) -> None:
+    """SavedModel-parity export of the trained forward (EMA shadow when
+    enabled — the tf export recipe used ema variables). The host gather
+    inside export_model is collective, so every process enters; only
+    process 0 writes."""
+    if not args.export_dir:
+        return
+    from ..serving import export_model
+    from ..train.optimizers import find_ema_params
+    params = (find_ema_params(state.opt_state)
+              if cfg.optimizer.ema_decay > 0 else None)
+    artifact = export_model(
+        model, params if params is not None else state.params,
+        state.extras, args.export_dir,
+        batch_size=min(8, cfg.data.batch_size))
+    if (ctx.process_index if ctx else 0) == 0:
+        log.info("exported servable: %s", artifact)
 
 
 if __name__ == "__main__":

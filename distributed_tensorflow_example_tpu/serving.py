@@ -1,0 +1,133 @@
+"""Model export for serving — the SavedModel story, TPU-native.
+
+The reference era shipped trained models as SavedModels (graph +
+variables, servable without the training code). The XLA-world
+equivalent is :mod:`jax.export`: the jitted forward function is lowered
+to StableHLO once, with the trained parameters baked in as constants,
+and serialized to a stable, self-contained artifact that any later JAX
+process (or the C++ PJRT runtime) can run WITHOUT this framework's
+Python code — the same portability contract a SavedModel gave
+Session.run (SURVEY.md §2.3).
+
+Artifacts are batch-polymorphic by default: the leading batch dimension
+is exported symbolically, so one artifact serves any batch size.
+
+Layout of an export directory::
+
+    <dir>/model.stablehlo     the serialized jax.export artifact
+    <dir>/export.json         metadata: model name, input signature,
+                              platforms, param count, versions
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+# label-side batch keys never consumed by `apply` (loss/eval only):
+# pruned from the serving signature so a servable takes features only
+_LABEL_KEYS = ("y", "masked_labels", "masked_weights", "__valid__")
+
+_ARTIFACT = "model.stablehlo"
+_META = "export.json"
+
+
+def serving_signature(batch: dict[str, Any]) -> dict[str, Any]:
+    """The feature-only view of a training batch."""
+    return {k: v for k, v in batch.items() if k not in _LABEL_KEYS}
+
+
+def export_model(model, params, extras, out_dir: str, *,
+                 sample_batch: dict[str, Any] | None = None,
+                 batch_size: int = 8,
+                 platforms: Sequence[str] = ("cpu", "tpu"),
+                 batch_polymorphic: bool = True) -> str:
+    """Serialize ``model.apply(params, extras, features, train=False)``
+    with the parameters baked in; returns the artifact path.
+
+    ``platforms`` lowers one artifact for every listed backend (the
+    default covers this sandbox's CPU tests and the TPU target).
+    ``batch_polymorphic`` exports the leading dimension symbolically.
+    """
+    batch = sample_batch or model.dummy_batch(batch_size)
+    features = serving_signature(batch)
+
+    # gather to host before baking: closed-over constants must be fully
+    # addressable on this process, but fsdp params span hosts (same
+    # reason the checkpoint writer allgathers — ckpt/checkpoint.py
+    # _to_host)
+    from .ckpt.checkpoint import _to_host
+    params = jax.tree_util.tree_map(_to_host, params)
+    extras = jax.tree_util.tree_map(_to_host, extras)
+
+    def serve(feats):
+        logits, _ = model.apply(params, extras, feats, train=False)
+        return logits
+
+    if batch_polymorphic:
+        specs = jax_export.symbolic_args_specs(
+            (features,), "b, ...")[0]
+    else:
+        specs = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                           jnp.asarray(x).dtype),
+            features)
+    exported = jax_export.export(
+        jax.jit(serve), platforms=list(platforms))(specs)
+
+    artifact = os.path.join(out_dir, _ARTIFACT)
+    if jax.process_index() != 0:
+        # the gather above is collective (all processes), the artifact
+        # write is chief-only — same division as the checkpoint writer
+        return artifact
+    os.makedirs(out_dir, exist_ok=True)
+    with open(artifact, "wb") as f:
+        f.write(exported.serialize())
+    signature = {
+        k: {"shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype)}
+        for k, v in features.items()}
+    with open(os.path.join(out_dir, _META), "w") as f:
+        json.dump({
+            "model": getattr(model, "name", type(model).__name__),
+            "input_signature": signature,
+            "batch_polymorphic": batch_polymorphic,
+            "platforms": list(platforms),
+            "param_count": sum(
+                int(np.size(p))
+                for p in jax.tree_util.tree_leaves(params)),
+            "jax_version": jax.__version__,
+            "calling_convention_version":
+                exported.calling_convention_version,
+        }, f, indent=1)
+    return artifact
+
+
+class ServableModel:
+    """A loaded export: ``servable(features) -> logits``.
+
+    Runs the deserialized StableHLO artifact — the training framework's
+    model code is NOT needed (and not consulted)."""
+
+    def __init__(self, directory: str):
+        with open(os.path.join(directory, _META)) as f:
+            self.meta = json.load(f)
+        with open(os.path.join(directory, _ARTIFACT), "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        self._call = jax.jit(self._exported.call)
+
+    @property
+    def input_signature(self) -> dict:
+        return self.meta["input_signature"]
+
+    def __call__(self, features: dict[str, Any]):
+        return self._call(features)
+
+
+def load_servable(directory: str) -> ServableModel:
+    return ServableModel(directory)

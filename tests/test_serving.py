@@ -1,0 +1,143 @@
+"""Serving export (serving.py): jax.export artifacts with baked params.
+
+Contract: the artifact is self-contained (deserialized and run without
+the model object), numerically identical to the live forward, and
+batch-polymorphic (one artifact, any leading batch size).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import TrainConfig
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.serving import (ServableModel,
+                                                        export_model,
+                                                        load_servable,
+                                                        serving_signature)
+
+
+def _init(model):
+    out = model.init(jax.random.key(0))
+    return out if isinstance(out, tuple) else (out, {})
+
+
+@pytest.mark.parametrize("name", ["mlp", "lenet", "bert_tiny"])
+def test_export_roundtrip_matches_live_forward(name, tmp_path):
+    cfg = TrainConfig(model=name)
+    m = get_model(name, cfg)
+    params, extras = _init(m)
+    d = str(tmp_path / name)
+    artifact = export_model(m, params, extras, d, platforms=("cpu",))
+    assert os.path.exists(artifact)
+
+    sv = load_servable(d)
+    feats = serving_signature(m.dummy_batch(4))
+    got = np.asarray(sv(feats))
+    want = np.asarray(m.apply(params, extras, feats, train=False)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_batch_polymorphism(tmp_path):
+    cfg = TrainConfig(model="mlp")
+    m = get_model("mlp", cfg)
+    params, extras = _init(m)
+    d = str(tmp_path / "m")
+    export_model(m, params, extras, d, platforms=("cpu",), batch_size=8)
+    sv = load_servable(d)
+    for bs in (1, 3, 32):
+        feats = serving_signature(m.dummy_batch(bs))
+        assert sv(feats).shape == (bs, 10)
+
+
+def test_metadata_written(tmp_path):
+    cfg = TrainConfig(model="mlp")
+    m = get_model("mlp", cfg)
+    params, extras = _init(m)
+    d = str(tmp_path / "m")
+    export_model(m, params, extras, d, platforms=("cpu",))
+    meta = json.load(open(os.path.join(d, "export.json")))
+    assert meta["model"] == "mlp"
+    assert meta["batch_polymorphic"] is True
+    assert "x" in meta["input_signature"]
+    assert meta["param_count"] == sum(
+        int(np.size(p)) for p in jax.tree_util.tree_leaves(params))
+    sv = ServableModel(d)
+    assert sv.input_signature == meta["input_signature"]
+
+
+def test_artifact_is_self_contained(tmp_path):
+    """The servable must run from the serialized bytes alone — no model
+    object, params, or registry involved."""
+    cfg = TrainConfig(model="mlp")
+    m = get_model("mlp", cfg)
+    params, extras = _init(m)
+    d = str(tmp_path / "m")
+    export_model(m, params, extras, d, platforms=("cpu",))
+    feats = serving_signature(m.dummy_batch(2))
+    want = np.asarray(m.apply(params, extras, feats, train=False)[0])
+    del m, params, extras
+
+    from jax import export as jax_export
+    with open(os.path.join(d, "model.stablehlo"), "rb") as f:
+        rehydrated = jax_export.deserialize(f.read())
+    got = np.asarray(rehydrated.call(feats))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_cli_export(tmp_path):
+    from distributed_tensorflow_example_tpu.cli.train import main
+    exp = str(tmp_path / "exp")
+    rc = main(["--model", "mlp", "--train_steps", "3",
+               "--batch_size", "32", "--export_dir", exp])
+    assert rc == 0
+    sv = load_servable(exp)
+    cfg = TrainConfig(model="mlp")
+    m = get_model("mlp", cfg)
+    feats = serving_signature(m.dummy_batch(4))
+    assert sv(feats).shape == (4, 10)
+
+
+def test_cli_eval_only_export(tmp_path):
+    """Export-from-checkpoint: restore an existing run and ship the
+    servable without retraining."""
+    from distributed_tensorflow_example_tpu.cli.train import main
+    ck = str(tmp_path / "ck")
+    rc = main(["--model", "mlp", "--train_steps", "4", "--batch_size",
+               "32", "--ckpt_dir", ck, "--save_steps", "4"])
+    assert rc == 0
+    exp = str(tmp_path / "exp")
+    rc = main(["--model", "mlp", "--eval_only", "--ckpt_dir", ck,
+               "--export_dir", exp, "--batch_size", "32"])
+    assert rc == 0
+    sv = load_servable(exp)
+    cfg = TrainConfig(model="mlp")
+    feats = serving_signature(get_model("mlp", cfg).dummy_batch(2))
+    assert sv(feats).shape == (2, 10)
+
+
+def test_cli_export_dir_fail_fast(tmp_path):
+    """An uncreatable --export_dir dies before training, not after.
+    (A plain file at the path makes makedirs fail even for root, which
+    ignores permission bits.)"""
+    from distributed_tensorflow_example_tpu.cli.train import main
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("x")
+    with pytest.raises(SystemExit, match="export_dir"):
+        main(["--model", "mlp", "--train_steps", "1",
+              "--export_dir", str(blocker)])
+
+
+def test_exported_bert_takes_feature_keys_only(tmp_path):
+    cfg = TrainConfig(model="bert_tiny")
+    m = get_model("bert_tiny", cfg)
+    params, extras = _init(m)
+    d = str(tmp_path / "b")
+    export_model(m, params, extras, d, platforms=("cpu",))
+    meta = json.load(open(os.path.join(d, "export.json")))
+    assert "masked_labels" not in meta["input_signature"]
+    assert "masked_weights" not in meta["input_signature"]
+    assert "input_ids" in meta["input_signature"]
