@@ -40,7 +40,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="mlp",
                    help="mlp | pipe_mlp | lenet | resnet20 | resnet50 | "
                         "bert | bert_large | bert_tiny | moe_bert | "
-                        "moe_bert_tiny")
+                        "moe_bert_tiny | pipe_bert | pipe_bert_tiny")
     p.add_argument("--dataset", default=None,
                    help="default: the model's canonical dataset")
     p.add_argument("--data_dir", default=None,
@@ -367,7 +367,8 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)
     elif name in ("bert", "bert_large", "bert_tiny",
-                  "moe_bert", "moe_bert_tiny"):
+                  "moe_bert", "moe_bert_tiny",
+                  "pipe_bert", "pipe_bert_tiny"):
         from ..data.bert_data import get_bert_data
         # take vocab/prediction shapes from the MODEL so data and logits
         # can never diverge (out-of-range labels clamp silently under jit)

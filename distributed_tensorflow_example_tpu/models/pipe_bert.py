@@ -1,0 +1,162 @@
+"""Pipeline-parallel BERT: GPipe over the encoder stack.
+
+The reference has no pipeline parallelism (SURVEY.md §2.5: PP absent,
+not required for parity); round 3 delivered the mechanism on an MLP
+(:mod:`.pipe_mlp`). This model applies it to the transformer family:
+the L encoder layers live STACKED (one pytree with leading dim L),
+sharded over the ``pipe`` mesh axis — each stage holds ``L/P``
+consecutive layers — while the embedding front-end and MLM head stay
+replicated outside the pipeline. Microbatches flow through the stages
+via the shared :func:`~..parallel.pipeline.pipeline_spmd` ring
+(``ppermute`` neighbor hops over ICI).
+
+Correctness contract (asserted in tests/test_pipe_bert.py): bound to a
+``pipe > 1`` mesh, outputs/loss/grads equal the unbound single-device
+model — including dropout, because BOTH paths split the batch into
+``microbatches`` and fold the per-(microbatch, layer) key the same way
+(the pipeline hands each stage the microbatch index it is processing;
+the sequential oracle maps over microbatches with the same indices).
+
+Composes with data parallelism exactly like PipeMlp: on a
+``{data, pipe}`` mesh each data shard runs its own P-stage pipeline and
+XLA inserts the gradient all-reduce over ``data``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..config import TrainConfig
+from ..parallel.mesh import AxisNames
+from ..parallel.pipeline import make_pipeline, sequential_blocks
+from ..parallel.sharding import ShardingRules
+from ..ops import nn
+from .base import cast_floating, register_model, resolve_dtype
+from .bert import Bert, BertConfig, _make
+
+
+@dataclasses.dataclass
+class PipeBertConfig(BertConfig):
+    microbatches: int = 4       # GPipe M (per data shard)
+
+
+class PipeBert(Bert):
+    """BERT with the encoder stack stacked+pipelined over ``pipe``."""
+
+    name = "pipe_bert"
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._pipe_mesh = None
+
+    # ------------------------------------------------------------------
+    def bind_mesh(self, mesh) -> None:
+        if mesh is not None and mesh.shape[AxisNames.PIPE] > 1:
+            if self.cfg.layers % mesh.shape[AxisNames.PIPE]:
+                raise ValueError(
+                    f"layers={self.cfg.layers} not divisible by pipe "
+                    f"axis size {mesh.shape[AxisNames.PIPE]}")
+            self._pipe_mesh = mesh
+        else:
+            self._pipe_mesh = None
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        flat = super().init(rng)
+        c = self.cfg
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[flat.pop(f"layer_{i}") for i in range(c.layers)])
+        flat["layers"] = stacked
+        return flat
+
+    # ------------------------------------------------------------------
+    def _stage_fn(self, *, offset_fn, train: bool, use_dropout: bool,
+                  rng):
+        """(local_stack, {h, mask}, mb_idx) -> same-structure pytree:
+        applies this stage's layers in order. ``offset_fn(n_local)``
+        yields the stage's first GLOBAL layer index — per-layer dropout
+        keys fold (global layer, microbatch), so pipelined and
+        sequential paths derive identical randomness."""
+        layer = self._maybe_remat(
+            functools.partial(self._layer, train=train,
+                              use_dropout=use_dropout))
+
+        def stage(stack, x, mb_idx):
+            n_local = jax.tree_util.tree_leaves(stack)[0].shape[0]
+            offset = offset_fn(n_local)
+
+            def body(h, xs):
+                lp, j = xs
+                lrng = None
+                if use_dropout:
+                    lrng = jax.random.fold_in(
+                        jax.random.fold_in(rng, offset + j), mb_idx)
+                return layer(lp, h, x["mask"], lrng), None
+
+            h, _ = lax.scan(body, x["h"],
+                            (stack, jnp.arange(n_local)))
+            return {"h": h, "mask": x["mask"]}
+
+        return stage
+
+    def encode(self, params, batch, rng=None, train: bool = False):
+        c = self.cfg
+        h, mask, use_dropout = self._embed(params, batch, rng, train)
+        x = {"h": h, "mask": mask}
+        if self._pipe_mesh is not None:
+            mesh = self._pipe_mesh
+            stage = self._stage_fn(
+                offset_fn=lambda n_local:
+                    lax.axis_index(AxisNames.PIPE) * n_local,
+                train=train, use_dropout=use_dropout, rng=rng)
+            piped = make_pipeline(mesh, stage,
+                                  num_microbatches=c.microbatches)
+            out = piped(params["layers"], x)
+        else:
+            stage = self._stage_fn(offset_fn=lambda n_local: 0,
+                                   train=train, use_dropout=use_dropout,
+                                   rng=rng)
+            # dropout keys are per-microbatch: the oracle must split the
+            # same way; without dropout one "microbatch" is exact and
+            # cheapest
+            m = c.microbatches if use_dropout else 1
+            out = sequential_blocks(stage, params["layers"], x,
+                                    num_microbatches=m)
+        return out["h"]
+
+    # ------------------------------------------------------------------
+    def sharding_rules(self, mesh_shape) -> ShardingRules:
+        """Stacked encoder sharded over pipe (stage placement); TP rules
+        are not combined with PP here — embeddings/head follow the
+        default replicated/fsdp policy."""
+        fsdp = getattr(mesh_shape, "fsdp", 1) if mesh_shape else 1
+        pipe = getattr(mesh_shape, "pipe", 1) if mesh_shape else 1
+        if pipe <= 1:
+            return ShardingRules(fsdp_axis_size=fsdp)
+        # \b, not ^: rule paths come prefixed (params/layers/... in a
+        # TrainState) — an anchored rule silently never matches and the
+        # stack would fall back to replicated placement
+        return ShardingRules(rules=[
+            (r"\blayers/", P(AxisNames.PIPE)),
+        ], fsdp_axis_size=fsdp)
+
+
+@register_model("pipe_bert")
+def _make_pipe_bert(config: TrainConfig) -> PipeBert:
+    cfg = PipeBertConfig()
+    return _make(config, cfg, cls=PipeBert)
+
+
+@register_model("pipe_bert_tiny")
+def _make_pipe_bert_tiny(config: TrainConfig) -> PipeBert:
+    t = BertConfig.tiny()
+    cfg = PipeBertConfig(**dataclasses.asdict(t))
+    cfg.layers = 4              # 2 stages x 2 layers on the test mesh
+    return _make(config, cfg, config_vocab=False, cls=PipeBert)

@@ -78,7 +78,7 @@ class PipeMlp:
                     f"blocks={self.cfg.blocks} not divisible by pipe axis "
                     f"size {mesh.shape[AxisNames.PIPE]}")
             self._pipelined = make_pipeline(
-                mesh, lambda p, x: _block_scan(p, x, self.dtype),
+                mesh, lambda p, x, mb_idx: _block_scan(p, x, self.dtype),
                 num_microbatches=self.cfg.microbatches)
         else:
             self._pipelined = None

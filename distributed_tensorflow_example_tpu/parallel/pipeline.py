@@ -45,28 +45,35 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import AxisNames
 
-# stage_fn(stage_params, x) -> y with y.shape == x.shape (homogeneous
-# blocks; the leading dim of every stage_params leaf is the per-stage
-# block count L/P)
-StageFn = Callable[[Any, jax.Array], jax.Array]
+# stage_fn(stage_params, x, mb_idx) -> y with the same pytree
+# structure/shapes as x (homogeneous blocks; the leading dim of every
+# stage_params leaf is the per-stage block count L/P). ``x`` may be a
+# bare array or a pytree — transformer stages thread (activations,
+# attention mask) together; passthrough leaves must come back unchanged.
+# ``mb_idx`` is the microbatch index this tick processes (clamped during
+# fill/drain, when the compute is bubble anyway) — stages use it to fold
+# per-microbatch randomness (dropout) deterministically.
+StageFn = Callable[[Any, Any, jax.Array], Any]
+
+_tmap = jax.tree_util.tree_map
 
 
 def pipeline_spmd(stage_fn: StageFn, stage_params, microbatches,
-                  *, axis_name: str = AxisNames.PIPE) -> jax.Array:
+                  *, axis_name: str = AxisNames.PIPE):
     """Per-shard GPipe body — call inside ``shard_map``.
 
     Args:
       stage_fn: applies this stage's blocks to one microbatch.
       stage_params: this stage's parameter shard (leading dim ``L/P``).
-      microbatches: ``[M, mb, ...]`` — the local batch pre-split into M
-        microbatches, replicated over the pipe axis.
+      microbatches: pytree with ``[M, mb, ...]`` leaves — the local batch
+        pre-split into M microbatches, replicated over the pipe axis.
 
-    Returns ``[M, mb, ...]``: the final stage's outputs, identical on every
-    pipe member.
+    Returns the same pytree with the final stage's outputs, identical on
+    every pipe member.
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
-    m = microbatches.shape[0]
+    m = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
 
     # non-circular shift: stage i -> i+1; stage 0 receives zeros (unused —
     # it always reads from the microbatch queue)
@@ -77,26 +84,34 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, microbatches,
         # stage 0 dequeues microbatch t (clamped during drain, when its
         # compute is bubble anyway); later stages take the ppermute'd
         # activation from their predecessor
-        x = jnp.where(me == 0, microbatches[jnp.minimum(t, m - 1)], recv)
-        y = stage_fn(stage_params, x)
+        x = _tmap(lambda q, r: jnp.where(me == 0,
+                                         q[jnp.minimum(t, m - 1)], r),
+                  microbatches, recv)
+        # stage ``me`` works on microbatch t - me at tick t
+        mb_idx = jnp.clip(t - me, 0, m - 1)
+        y = stage_fn(stage_params, x, mb_idx)
         # the last stage completes microbatch t-(n-1) at tick t
         out_idx = t - (n - 1)
-        upd = lax.dynamic_update_index_in_dim(
-            outputs, y, jnp.maximum(out_idx, 0), 0)
-        outputs = jnp.where(out_idx >= 0, upd, outputs)
-        recv = lax.ppermute(y, axis_name, perm)
+        safe = jnp.maximum(out_idx, 0)
+        outputs = _tmap(
+            lambda o, yy: jnp.where(
+                out_idx >= 0,
+                lax.dynamic_update_index_in_dim(o, yy, safe, 0), o),
+            outputs, y)
+        recv = _tmap(lambda yy: lax.ppermute(yy, axis_name, perm), y)
         return (recv, outputs), None
 
-    zero = jnp.zeros_like(microbatches[0])
+    zero = _tmap(lambda q: jnp.zeros_like(q[0]), microbatches)
     (_, outputs), _ = lax.scan(
-        tick, (zero, jnp.zeros_like(microbatches)),
+        tick, (zero, _tmap(jnp.zeros_like, microbatches)),
         jnp.arange(m + n - 1))
 
     # broadcast the final stage's buffer to every pipe member (all other
     # stages contribute zeros); psum's transpose is the identity per shard,
     # so gradients re-enter the drain ticks correctly
-    outputs = jnp.where(me == n - 1, outputs, jnp.zeros_like(outputs))
-    return lax.psum(outputs, axis_name)
+    outputs = _tmap(lambda o: jnp.where(me == n - 1, o,
+                                        jnp.zeros_like(o)), outputs)
+    return _tmap(lambda o: lax.psum(o, axis_name), outputs)
 
 
 def make_pipeline(mesh: Mesh, stage_fn: StageFn, *,
@@ -121,29 +136,42 @@ def make_pipeline(mesh: Mesh, stage_fn: StageFn, *,
                 f"block count {L} not divisible by pipe axis size {n_pipe}")
 
         def body(params_local, x_local):
-            b = x_local.shape[0]
+            b = jax.tree_util.tree_leaves(x_local)[0].shape[0]
             if b % num_microbatches:
                 raise ValueError(
                     f"per-shard batch {b} not divisible by "
                     f"num_microbatches={num_microbatches}")
-            mb = x_local.reshape(
-                (num_microbatches, b // num_microbatches) + x_local.shape[1:])
+            mb = _tmap(
+                lambda a: a.reshape((num_microbatches,
+                                     b // num_microbatches) + a.shape[1:]),
+                x_local)
             out = pipeline_spmd(stage_fn, params_local, mb,
                                 axis_name=pipe_axis)
-            return out.reshape(x_local.shape)
+            return _tmap(lambda a: a.reshape((b,) + a.shape[2:]), out)
 
-        params_specs = jax.tree_util.tree_map(
-            lambda _: P(pipe_axis), stacked_params)
+        params_specs = _tmap(lambda _: P(pipe_axis), stacked_params)
+        x_specs = _tmap(lambda _: P(batch_axes), x)
         return jax.shard_map(
             body, mesh=mesh,
-            in_specs=(params_specs, P(batch_axes)),
-            out_specs=P(batch_axes), check_vma=False)(stacked_params, x)
+            in_specs=(params_specs, x_specs),
+            out_specs=x_specs, check_vma=False)(stacked_params, x)
 
     return apply
 
 
-def sequential_blocks(stage_fn: StageFn, stacked_params, x) -> jax.Array:
+def sequential_blocks(stage_fn: StageFn, stacked_params, x,
+                      *, num_microbatches: int = 1):
     """Unpartitioned oracle: apply ALL stacked blocks in order on one
-    device (what the pipeline computes, minus the pipelining). Used as the
-    pipe-axis-absent fallback and as the parity target in tests."""
-    return stage_fn(stacked_params, x)
+    device (what the pipeline computes, minus the pipelining), with the
+    same per-microbatch split so mb-indexed randomness matches. Used as
+    the pipe-axis-absent fallback and as the parity target in tests."""
+    b = jax.tree_util.tree_leaves(x)[0].shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"num_microbatches={num_microbatches}")
+    mb = _tmap(lambda a: a.reshape(
+        (num_microbatches, b // num_microbatches) + a.shape[1:]), x)
+    out = jax.lax.map(
+        lambda args: stage_fn(stacked_params, args[0], args[1]),
+        (mb, jnp.arange(num_microbatches)))
+    return _tmap(lambda a: a.reshape((b,) + a.shape[2:]), out)

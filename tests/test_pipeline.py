@@ -25,7 +25,7 @@ from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
 from distributed_tensorflow_example_tpu.train.optimizers import make_optimizer
 
 
-def _stage_fn(stacked, x):
+def _stage_fn(stacked, x, mb_idx=0):
     def body(h, blk):
         return h + jax.nn.relu(h @ blk["kernel"] + blk["bias"]), None
     out, _ = jax.lax.scan(body, x, stacked)
