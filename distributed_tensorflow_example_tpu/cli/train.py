@@ -73,9 +73,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--learning_rate", type=float, default=0.5)
     p.add_argument("--optimizer", default="sgd", type=str.lower,
                    choices=["sgd", "momentum", "adam", "adamw",
-                            "lars", "lamb"],
+                            "lars", "lamb", "adafactor"],
                    help="base optimizer (lars/lamb: the large-batch "
-                        "ImageNet/BERT recipes for sync-DP scaling)")
+                        "ImageNet/BERT recipes for sync-DP scaling; "
+                        "adafactor: factored second moments, the "
+                        "T5/TPU memory-frugal recipe — NOTE its "
+                        "--weight_decay is a constant per-step rate, "
+                        "not LR-scaled like adamw's)")
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--wd_mask", default="exclude_1d",

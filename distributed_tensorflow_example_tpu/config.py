@@ -52,7 +52,9 @@ class OptimizerConfig:
     SyncReplicasOptimizer, SURVEY.md §2.1)."""
 
     name: str = "sgd"               # sgd | momentum | adam | adamw |
-                                    # lars | lamb (large-batch recipes)
+                                    # lars | lamb (large-batch recipes) |
+                                    # adafactor (factored 2nd moments;
+                                    # momentum=0 -> T5 memory-frugal)
     learning_rate: float = 0.5
     momentum: float = 0.9
     weight_decay: float = 0.0

@@ -139,6 +139,26 @@ def state_shardings(mesh: Mesh, state: PyTree,
         shape = np.shape(x)
         if len(shape) == 0:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, rules.spec_for(_path_str(path), shape))
+        s = rules.spec_for(_path_str(path), shape)
+        # "when shapes match", enforced: factored optimizer state
+        # (adafactor's v_row/v_col vectors and (1,) placeholders)
+        # embeds param PATHS at other ranks/sizes — a kernel rule's
+        # spec cannot apply to those leaves, so they replicate instead
+        # of failing placement. Params themselves always match their
+        # own rules, so this only relaxes derived state
+        if len(s) > len(shape) or any(
+                s[i] is not None and shape[i] % _axes_size(mesh, s[i])
+                for i in range(len(s))):
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, s)
 
     return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def _axes_size(mesh: Mesh, axes) -> int:
+    if isinstance(axes, (tuple, list)):
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        return size
+    return mesh.shape[axes]

@@ -248,9 +248,26 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
                 "silent no-op")
         parts.append(optax.lamb(sched, weight_decay=cfg.weight_decay,
                                 mask=mask))
+    elif name == "adafactor":
+        # the T5/TPU-era memory-frugal optimizer: factored second
+        # moments (row+col vectors instead of a full matrix — O(n+m)
+        # optimizer HBM per weight matrix). --momentum participates:
+        # pass 0 for the classic momentum-free T5 setup (least memory);
+        # the accumulator follows moment_dtype when enabled.
+        # NOTE weight_decay here is optax.adafactor's CONSTANT per-step
+        # rate (the T5 recipe), NOT LR-schedule-scaled like adamw's —
+        # a 0.01 that anneals with the schedule under adamw decays a
+        # constant 1%/step here; scale it down accordingly
+        parts.append(optax.adafactor(
+            sched,
+            momentum=cfg.momentum if cfg.momentum > 0 else None,
+            dtype_momentum=mdt,
+            weight_decay_rate=cfg.weight_decay or None,
+            weight_decay_mask=mask))
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
-    if cfg.weight_decay > 0 and name not in ("adamw", "lars", "lamb"):
+    if cfg.weight_decay > 0 and name not in ("adamw", "lars", "lamb",
+                                             "adafactor"):
         parts.insert(-1, optax.add_decayed_weights(cfg.weight_decay,
                                                    mask=mask))
     if cfg.ema_decay > 0:
