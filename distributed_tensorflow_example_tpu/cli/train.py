@@ -106,6 +106,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decay_factor", type=float, default=0.1,
                    help="piecewise: LR multiplier at each boundary; "
                         "exponential: decay rate per decay_steps")
+    p.add_argument("--moe_experts", type=int, default=None,
+                   help="MoE models: experts per MoE layer (default: "
+                        "the model's; moe_bert=8)")
+    p.add_argument("--moe_top_k", type=int, default=None,
+                   help="MoE models: routed experts per token (1 = "
+                        "Switch; 2 = classic top-2 gating)")
+    p.add_argument("--moe_capacity_factor", type=float, default=None,
+                   help="MoE models: per-expert slot headroom "
+                        "C = ceil(T/E * factor); overflow tokens drop "
+                        "to the residual path")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="smooth training targets (image classifiers: "
                         "lenet/resnet20/resnet50; the standard ImageNet "
@@ -238,6 +248,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         model=args.model,
         train_steps=args.train_steps,
         label_smoothing=args.label_smoothing,
+        moe_experts=args.moe_experts,
+        moe_top_k=args.moe_top_k,
+        moe_capacity_factor=args.moe_capacity_factor,
         eval_every_steps=args.eval_every_steps,
         steps_per_loop=args.steps_per_loop,
         seed=args.seed,
@@ -451,6 +464,13 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(
             f"--label_smoothing is wired for the image classifiers "
             f"(lenet/resnet20/resnet50), not model {args.model!r}")
+    for flag, val in (("--moe_experts", args.moe_experts),
+                      ("--moe_top_k", args.moe_top_k),
+                      ("--moe_capacity_factor", args.moe_capacity_factor)):
+        if val is not None and not args.model.startswith("moe_"):
+            raise SystemExit(
+                f"{flag} is an MoE routing knob (moe_bert/"
+                f"moe_bert_tiny), not for model {args.model!r}")
 
     cluster = None
     if args.ps_hosts or args.worker_hosts:

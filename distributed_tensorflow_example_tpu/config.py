@@ -199,6 +199,11 @@ class TrainConfig:
     label_smoothing: float = 0.0     # image classifiers (resnet20/50):
                                      # smooth training targets; eval
                                      # metrics stay unsmoothed
+    # MoE model knobs (moe_bert*): None = the model's default. The CLI
+    # rejects them for non-MoE models (no silently ignored knobs)
+    moe_experts: int | None = None       # experts per MoE layer
+    moe_top_k: int | None = None         # routed experts per token
+    moe_capacity_factor: float | None = None
     eval_every_steps: int = 0        # 0 => eval only at the end
     steps_per_loop: int = 1          # steps per device dispatch (lax.scan
                                      # inner loop — TPU-era iterations_per_loop

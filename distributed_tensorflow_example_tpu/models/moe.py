@@ -150,14 +150,43 @@ class MoeBert(Bert):
                              fsdp_axis_size=base.fsdp_axis_size)
 
 
+def _apply_moe_overrides(cfg: MoeBertConfig,
+                         config: TrainConfig) -> MoeBertConfig:
+    """CLI-reachable routing knobs (--moe_experts/--moe_top_k/
+    --moe_capacity_factor); None keeps the model default."""
+    if config.moe_experts is not None:
+        if config.moe_experts < 1:
+            raise ValueError(
+                f"moe_experts={config.moe_experts} must be >= 1")
+        cfg.n_experts = config.moe_experts
+    if config.moe_top_k is not None:
+        cfg.top_k = config.moe_top_k
+    if not 1 <= cfg.top_k <= cfg.n_experts:
+        # validate the COMBINED result: --moe_experts alone can push
+        # n_experts below the model's default top_k
+        raise ValueError(
+            f"moe_top_k={cfg.top_k} must be in "
+            f"[1, n_experts={cfg.n_experts}]")
+    if config.moe_capacity_factor is not None:
+        if config.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor={config.moe_capacity_factor} "
+                "must be > 0 (capacity would clamp to 1 slot and drop "
+                "nearly every token)")
+        cfg.capacity_factor = config.moe_capacity_factor
+    return cfg
+
+
 @register_model("moe_bert")
 def _make_moe_bert(config: TrainConfig) -> MoeBert:
     from .bert import _make
-    return _make(config, MoeBertConfig(), cls=MoeBert)
+    return _make(config, _apply_moe_overrides(MoeBertConfig(), config),
+                 cls=MoeBert)
 
 
 @register_model("moe_bert_tiny")
 def _make_moe_bert_tiny(config: TrainConfig) -> MoeBert:
     from .bert import _make
-    return _make(config, MoeBertConfig.tiny(), config_vocab=False,
-                 cls=MoeBert)
+    return _make(config, _apply_moe_overrides(MoeBertConfig.tiny(),
+                                              config),
+                 config_vocab=False, cls=MoeBert)

@@ -10,6 +10,7 @@ training under SyncReplicas with expert-sharded rules.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_example_tpu.config import (MeshShape,
                                                        OptimizerConfig,
@@ -222,3 +223,82 @@ def test_moe_bert_learns_expert_sharded(cpu8):
         state, metr = sync.step(state, sync.shard_batch(b))
         losses.append(float(metr["loss"]))
     assert losses[-1] < losses[0]
+
+
+def _brute_force_topk(params, x2, k):
+    """out[t] = sum over the k best experts of gate * FFN_e(x_t), with
+    the repeated-masked-argmax expert order and RAW (unrenormalized)
+    chosen probabilities — the _route contract."""
+    logits = x2 @ params["router"]["kernel"]
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    out = np.zeros_like(np.asarray(x2))
+    for t in range(x2.shape[0]):
+        remaining = probs[t].copy()
+        for _ in range(k):
+            e = int(np.argmax(remaining))
+            gate = probs[t][e]
+            h = np.asarray(x2[t]) @ np.asarray(params["w_in"][e]) \
+                + np.asarray(params["b_in"][e])
+            h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+            out[t] += gate * (h @ np.asarray(params["w_out"][e])
+                              + np.asarray(params["b_out"][e]))
+            remaining[e] = 0.0
+    return out
+
+
+def test_moe_ffn_matches_bruteforce_top2():
+    """Top-2 gating (the classic MoE recipe) against the per-token
+    oracle at generous capacity."""
+    params = _params()
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 8, 16).astype(np.float32))
+    got, _ = moe.moe_ffn(params, x, n_experts=4, top_k=2,
+                         capacity_factor=8.0)
+    want = _brute_force_topk(params, x.reshape(16, 16), 2).reshape(2, 8, 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_cli_knobs_reach_the_model():
+    cfg = TrainConfig(model="moe_bert_tiny", moe_experts=2, moe_top_k=2,
+                      moe_capacity_factor=3.0)
+    m = get_model("moe_bert_tiny", cfg)
+    assert m.cfg.n_experts == 2
+    assert m.cfg.top_k == 2
+    assert m.cfg.capacity_factor == 3.0
+    # top_k out of range errors — including via --moe_experts alone
+    with pytest.raises(ValueError, match="moe_top_k"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny", moe_top_k=9))
+    with pytest.raises(ValueError, match="moe_experts"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny", moe_experts=0))
+    with pytest.raises(ValueError, match="capacity_factor"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny",
+                              moe_capacity_factor=0.0))
+
+
+def test_moe_cli_guard_rejects_non_moe_model():
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="moe"):
+        main(["--model", "mlp", "--train_steps", "1", "--moe_top_k", "2"])
+
+
+def test_moe_bert_tiny_trains_top2(cpu8):
+    """top-2 routing trains end to end on the {data, expert} mesh."""
+    cfg = TrainConfig(model="moe_bert_tiny", moe_top_k=2,
+                      moe_capacity_factor=4.0)
+    m = get_model("moe_bert_tiny", cfg)
+    mesh = local_mesh(8, {"data": 2, "expert": 4})
+    tx = make_optimizer(OptimizerConfig(name="adamw", learning_rate=1e-3))
+    sync = SyncReplicas(m.loss, tx, mesh,
+                        rules=m.sharding_rules(MeshShape(data=2,
+                                                         expert=4)))
+    state = sync.init(m.init)
+    batch = sync.shard_batch(m.dummy_batch(16))
+    losses = []
+    for _ in range(6):
+        state, metrics = sync.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
