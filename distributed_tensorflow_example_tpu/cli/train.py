@@ -191,6 +191,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--summary_every_steps", type=int, default=0,
                    help="scalar-summary cadence to the metrics JSONL "
                         "(SummarySaverHook parity; 0 disables)")
+    p.add_argument("--param_histograms_every_steps", type=int, default=0,
+                   help="weight-histogram cadence "
+                        "(tf.summary.histogram parity: full "
+                        "HistogramProtos to --tb_logdir, summary stats "
+                        "to the JSONL; 0 disables)")
     p.add_argument("--metrics_path", default=None)
     p.add_argument("--tb_logdir", default=None,
                    help="write TensorBoard scalar event files here "
@@ -301,6 +306,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         obs=ObservabilityConfig(
             log_every_steps=args.log_every_steps,
             summary_every_steps=args.summary_every_steps,
+            param_histograms_every_steps=(
+                args.param_histograms_every_steps),
             metrics_path=args.metrics_path,
             tb_logdir=args.tb_logdir,
             check_nans=args.check_nans,

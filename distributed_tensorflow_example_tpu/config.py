@@ -174,6 +174,10 @@ class ObservabilityConfig:
     profile_dir: str | None = None
     check_nans: bool = False          # NanTensorHook analogue
     summary_every_steps: int = 0      # scalar summary cadence (0 disables)
+    param_histograms_every_steps: int = 0  # weight-histogram cadence
+                                           # (tf.summary.histogram
+                                           # parity; 0 disables; pulls
+                                           # params to host each time)
     debug_checks: bool = False        # checkify float_checks around the step
                                       # (SURVEY.md §5.2); debug-only cost
     debug_nans: bool = False          # jax.config jax_debug_nans flag

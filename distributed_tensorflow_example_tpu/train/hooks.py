@@ -199,6 +199,39 @@ class SummaryHook(Hook):
     # releases it); this hook must not close a logger it was handed
 
 
+class ParamHistogramHook(Hook):
+    """Write parameter-distribution histograms every N steps
+    (``tf.summary.histogram`` on trainable variables — the reference
+    era's weight-histogram dashboards). Opt-in: pulls params to host at
+    the cadence, so keep the interval generous for big models.
+
+    Multi-host: the host gather is collective (``_to_host``
+    process-allgathers non-addressable fsdp/tp shards — every process
+    must enter it, like checkpoint.save); the stats/logging loop itself
+    is chief-only per the module contract."""
+
+    def __init__(self, metrics_logger: MetricsLogger, every_steps: int):
+        self.metrics_logger = metrics_logger
+        self.every_steps = every_steps
+
+    def wants_metrics(self, step: int) -> bool:
+        return False          # reads trainer.state, never step metrics
+
+    def after_step(self, trainer, step, metrics):
+        if self.every_steps <= 0 or step % self.every_steps:
+            return
+        import jax
+
+        from ..ckpt.checkpoint import _to_host
+        from ..utils.pytree import path_str
+        params = jax.tree_util.tree_map(_to_host, trainer.state.params)
+        if jax.process_index() != 0:
+            return
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            self.metrics_logger.log_histogram(
+                step, "params/" + path_str(path), leaf)
+
+
 class GlobalStepWaiterHook(Hook):
     """Reference: delayed async-worker starts until the chief advanced the
     global step (basic_session_run_hooks.py:902). SPMD sync training has no

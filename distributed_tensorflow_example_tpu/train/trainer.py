@@ -110,6 +110,8 @@ class Trainer:
             for name, every in (("log_every_steps", config.obs.log_every_steps),
                                 ("summary_every_steps",
                                  config.obs.summary_every_steps),
+                                ("param_histograms_every_steps",
+                                 config.obs.param_histograms_every_steps),
                                 ("save_steps", config.checkpoint.save_steps),
                                 ("eval_every_steps", config.eval_every_steps)):
                 if every and every % k:
@@ -133,6 +135,10 @@ class Trainer:
         if cfg.obs.summary_every_steps:
             hs.append(hooks_lib.SummaryHook(self.metrics_logger,
                                             cfg.obs.summary_every_steps))
+        if cfg.obs.param_histograms_every_steps:
+            hs.append(hooks_lib.ParamHistogramHook(
+                self.metrics_logger,
+                cfg.obs.param_histograms_every_steps))
         if cfg.obs.check_nans:
             hs.append(hooks_lib.NanHook())
         if cfg.obs.step_timing:

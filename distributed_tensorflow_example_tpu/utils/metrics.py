@@ -48,6 +48,10 @@ class MetricsLogger:
             return isinstance(v, numbers.Number)
 
         out: dict[str, float] = {}
+        if "histogram" in record:
+            # distribution records go to TB as HistogramProtos via
+            # log_histogram; their JSONL summary stats are not scalars
+            return out
         for k, v in record.items():
             if k in ("step", "time"):
                 continue
@@ -71,6 +75,26 @@ class MetricsLogger:
                                  wall_time=record["time"])
         if self.also_stdout and jax.process_index() == 0:
             print(line, flush=True)
+
+    def log_histogram(self, step: int, tag: str, values) -> None:
+        """Distribution record: the JSONL gets compact summary stats
+        (greppable), the TB sink gets the full HistogramProto
+        (tf.summary.histogram parity)."""
+        import numpy as np
+        v = np.asarray(values, np.float64).reshape(-1)
+        if v.size == 0:
+            return
+        fin = v[np.isfinite(v)]
+        stats = ({"min": float(fin.min()), "max": float(fin.max()),
+                  "mean": float(fin.mean()), "std": float(fin.std())}
+                 if fin.size else {})
+        self.log({"step": step, "histogram": tag, **stats,
+                  "count": int(v.size),
+                  # NaN would be invalid strict JSON; surface the
+                  # pathology as a count instead
+                  "nonfinite": int(v.size - fin.size)})
+        if self._tb is not None:
+            self._tb.histogram(step, tag, v)
 
     def close(self) -> None:
         if self._f is not None:

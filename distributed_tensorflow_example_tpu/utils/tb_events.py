@@ -79,6 +79,67 @@ def _file_version_event(wall_time: float) -> bytes:
     return _double(1, wall_time) + _bytes(3, b"brain.Event:2")
 
 
+# ---------------------------------------------------------------------------
+# HistogramProto (tf.summary.histogram parity)
+# ---------------------------------------------------------------------------
+# Fields: min=1 max=2 num=3 sum=4 sum_squares=5 (doubles),
+# bucket_limit=6 bucket=7 (packed repeated doubles). Bucket semantics:
+# bucket[i] counts values in (bucket_limit[i-1], bucket_limit[i]].
+
+_DBL_MAX = 1.7976931348623157e308
+
+
+def _packed_doubles(field: int, values) -> bytes:
+    import numpy as _np
+    payload = _np.asarray(values, _np.float64).tobytes()
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
+def _tf_bucket_limits(max_abs: float) -> list:
+    """TF's default exponential buckets (histogram.cc: 1e-12 growing
+    ×1.1), generated only up to the data range, mirrored negative, with
+    the DBL_MAX catch-all."""
+    pos = []
+    v = 1e-12
+    while v < max_abs * 1.1 and len(pos) < 1000:
+        pos.append(v)
+        v *= 1.1
+    if not pos:
+        pos = [1e-12]
+    return [-x for x in reversed(pos)] + pos + [_DBL_MAX]
+
+
+def _histogram_proto(values) -> bytes:
+    import numpy as _np
+    v = _np.asarray(values, _np.float64).reshape(-1)
+    # the histogram shows the FINITE distribution: NaN/inf would make
+    # searchsorted overflow the bucket list (malformed proto) and poison
+    # the moments — non-finite debugging belongs to NanHook/checkify
+    v = v[_np.isfinite(v)]
+    if v.size == 0:
+        v = _np.zeros((1,), _np.float64)
+    limits = _np.asarray(_tf_bucket_limits(float(_np.max(_np.abs(v)))))
+    # bucket i holds values <= limits[i] (and > limits[i-1])
+    idx = _np.clip(_np.searchsorted(limits, v, side="left"), 0,
+                   len(limits) - 1)
+    counts = _np.bincount(idx, minlength=len(limits)).astype(_np.float64)
+    nz = _np.nonzero(counts)[0]
+    lo, hi = int(nz[0]), int(nz[-1])        # trim empty head/tail
+    return (_double(1, float(v.min())) + _double(2, float(v.max()))
+            + _double(3, float(v.size)) + _double(4, float(v.sum()))
+            + _double(5, float((v * v).sum()))
+            + _packed_doubles(6, limits[lo:hi + 1])
+            + _packed_doubles(7, counts[lo:hi + 1]))
+
+
+def _histo_event(step: int, tag: str, values, wall_time: float) -> bytes:
+    # Summary.Value{ tag=1:string, histo=5:HistogramProto }
+    # (4 is `image` — the legacy summary.proto field numbering)
+    value = _bytes(1, tag.encode()) + _bytes(5, _histogram_proto(values))
+    summary = _bytes(1, value)
+    return _double(1, wall_time) + _int64(2, step) + _bytes(5, summary)
+
+
 class EventFileWriter:
     """Append scalar summaries to an ``events.out.tfevents.*`` file.
 
@@ -116,6 +177,15 @@ class EventFileWriter:
         wt = time.time() if wall_time is None else wall_time
         for tag, v in values.items():
             self.scalar(step, tag, v, wt)
+        self._f.flush()
+
+    def histogram(self, step: int, tag: str, values,
+                  wall_time: float | None = None) -> None:
+        """``tf.summary.histogram`` parity: any array-like of values,
+        bucketed TF-style (exponential ×1.1 bins, mirrored)."""
+        self._record(_histo_event(step, tag, values,
+                                  time.time() if wall_time is None
+                                  else wall_time))
         self._f.flush()
 
     def flush(self) -> None:

@@ -59,3 +59,111 @@ def test_metrics_logger_tb_sink(tmp_path):
     assert (10, "eval/loss", 2.0) in scalars     # one-level flatten
     assert all(tag != "note" for _, tag, _ in scalars)
     assert len(scalars) == 3
+
+
+def test_histogram_against_tensorflow_reader(tmp_path):
+    """tf.summary.histogram parity: TF's summary_iterator must parse our
+    HistogramProto with correct moments, and the bucket counts must
+    cover every value."""
+    tf = pytest.importorskip("tensorflow")
+    rs = np.random.RandomState(0)
+    vals = np.concatenate([rs.randn(1000) * 2.0, [-7.5, 0.0, 9.25]])
+    w = EventFileWriter(str(tmp_path))
+    w.histogram(3, "weights/kernel", vals)
+    w.close()
+
+    path = glob.glob(str(tmp_path / "events.out.tfevents.*"))[0]
+    histos = []
+    for ev in tf.compat.v1.train.summary_iterator(path):
+        for v in ev.summary.value:
+            if v.HasField("histo"):
+                histos.append((ev.step, v.tag, v.histo))
+    assert len(histos) == 1
+    step, tag, h = histos[0]
+    assert step == 3 and tag == "weights/kernel"
+    assert h.min == pytest.approx(vals.min())
+    assert h.max == pytest.approx(vals.max())
+    assert h.num == pytest.approx(len(vals))
+    assert h.sum == pytest.approx(vals.sum(), rel=1e-9)
+    assert h.sum_squares == pytest.approx((vals ** 2).sum(), rel=1e-9)
+    assert sum(h.bucket) == pytest.approx(len(vals))
+    assert len(h.bucket) == len(h.bucket_limit)
+    # limits strictly increasing (TB rendering requirement)
+    limits = list(h.bucket_limit)
+    assert all(a < b for a, b in zip(limits, limits[1:]))
+
+
+def test_metrics_logger_histogram_both_sinks(tmp_path):
+    jpath = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(jpath, tb_logdir=str(tmp_path / "tb"))
+    logger.log_histogram(5, "params/w", np.arange(10.0))
+    logger.close()
+    import json
+    recs = [json.loads(l) for l in open(jpath)]
+    h = [r for r in recs if r.get("histogram") == "params/w"]
+    assert h and h[0]["count"] == 10 and h[0]["max"] == 9.0
+    # TB file got a record too (scalar pollution guarded separately)
+    assert glob.glob(str(tmp_path / "tb" / "events.out.tfevents.*"))
+
+
+def test_param_histogram_hook_end_to_end(tmp_path):
+    """--param_histograms_every_steps through the Trainer: JSONL gets
+    per-leaf distribution records at the cadence."""
+    import json
+
+    from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                           ObservabilityConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.data.mnist import (
+        synthetic_mnist)
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    data = synthetic_mnist(256, 64)
+    jpath = str(tmp_path / "m.jsonl")
+    cfg = TrainConfig(model="mlp", train_steps=4,
+                      data=DataConfig(batch_size=64),
+                      obs=ObservabilityConfig(
+                          metrics_path=jpath,
+                          param_histograms_every_steps=2))
+    tr = Trainer(get_model("mlp", cfg), cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    tr.train()
+    tr.close()
+    recs = [json.loads(l) for l in open(jpath)]
+    hrecs = [r for r in recs if "histogram" in r]
+    steps = sorted({r["step"] for r in hrecs})
+    assert steps == [2, 4], steps
+    tags = {r["histogram"] for r in hrecs if r["step"] == 2}
+    assert any(t.startswith("params/") for t in tags), tags
+
+
+def test_histogram_nonfinite_values_stay_wellformed(tmp_path):
+    """NaN/inf must not overflow the bucket list (malformed proto) —
+    the histogram shows the finite distribution, the JSONL surfaces the
+    pathology as a nonfinite count."""
+    tf = pytest.importorskip("tensorflow")
+    vals = np.array([1.0, np.nan, np.inf, -np.inf, 2.0])
+    w = EventFileWriter(str(tmp_path))
+    w.histogram(1, "w", vals)
+    w.close()
+    path = glob.glob(str(tmp_path / "events.out.tfevents.*"))[0]
+    histos = [v.histo for ev in tf.compat.v1.train.summary_iterator(path)
+              for v in ev.summary.value if v.HasField("histo")]
+    h = histos[0]
+    assert len(h.bucket) == len(h.bucket_limit)
+    assert h.num == 2                       # the finite values
+    assert sum(h.bucket) == pytest.approx(2)
+
+    import json
+    jpath = str(tmp_path / "m.jsonl")
+    logger = MetricsLogger(jpath)
+    logger.log_histogram(1, "w", vals)
+    logger.close()
+    rec = [json.loads(l) for l in open(jpath)
+           if "histogram" in l][0]
+    assert rec["nonfinite"] == 3 and rec["count"] == 5
+    assert rec["max"] == 2.0
