@@ -40,4 +40,32 @@ __all__ = [
     "build_mesh",
     "AxisNames",
     "TrainState",
+    "Trainer",
+    "get_model",
+    "warm_start",
+    "export_model",
+    "load_servable",
 ]
+
+
+def __getattr__(name):
+    # the user-facing workflow entry points, imported lazily: eager
+    # imports would pull jax model/trainer machinery into every
+    # `import dtx` (e.g. bench scripts that only want config)
+    if name == "Trainer":
+        from .train.trainer import Trainer
+        return Trainer
+    if name == "get_model":
+        from .models import get_model
+        return get_model
+    if name == "warm_start":
+        from .ckpt.warm_start import warm_start
+        return warm_start
+    if name == "export_model":
+        from .serving import export_model
+        return export_model
+    if name == "load_servable":
+        from .serving import load_servable
+        return load_servable
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

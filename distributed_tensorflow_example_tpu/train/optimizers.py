@@ -180,9 +180,14 @@ def reset_ema(opt_state: Any, params: Any) -> Any:
     """Re-anchor every EMA shadow in ``opt_state`` to ``params`` (count
     reset to 0). Needed when params are replaced outside the optimizer —
     warm start — since the shadow snapshotted the discarded init (tf
-    rewrote initializers BEFORE ema.apply snapshotted them)."""
-    fresh = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32),
-                                   params)
+    rewrote initializers BEFORE ema.apply snapshotted them).
+
+    The copy must be a REAL new buffer: this runs eagerly, where
+    ``astype(f32)`` on f32 params aliases — and a shadow aliasing its
+    param would be donated twice by the compiled step (runtime error).
+    """
+    fresh = jax.tree_util.tree_map(
+        lambda p: jnp.add(p.astype(jnp.float32), 0.0), params)
 
     def fix(x):
         if isinstance(x, EmaState):

@@ -46,3 +46,23 @@ def test_ps_branch_exits_zero_with_notice():
     assert r.returncode == 0, r.stdout + r.stderr
     out = r.stdout + r.stderr
     assert "No PS role on TPU" in out
+
+
+def test_finetune_export_lifecycle(tmp_path):
+    """examples/finetune_export.py: pretrain -> warm-start fine-tune
+    with EMA -> export EMA weights -> serve from the artifact alone."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "finetune_export",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "examples",
+            "finetune_export.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.run(str(tmp_path), pretrain_steps=40, finetune_steps=30)
+    assert out["pretrain_eval"]["accuracy"] > 0.9
+    assert out["finetune_eval"]["accuracy"] > 0.9
+    assert out["servable_accuracy_16"] > 0.9
+    assert os.path.exists(os.path.join(out["export_dir"],
+                                       "model.stablehlo"))
