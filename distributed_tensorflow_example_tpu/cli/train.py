@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="training augmentation (train split only): "
                         "ImageNet random-resized crop + flip (requires "
                         "--streaming) or CIFAR pad-4 crop + flip")
+    p.add_argument("--label_offset", type=int, default=0,
+                   help="TFRecord image shards: added to every label "
+                        "(tf-slim ImageNet writes 1-indexed labels: "
+                        "pass -1)")
     p.add_argument("--max_per_class", type=int, default=None,
                    help="cap eagerly-decoded images per class (ImageNet "
                         "folder loading; full train split is ~770GB as f32)")
@@ -270,6 +274,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                         batch_size=args.batch_size, seed=args.seed,
                         native=args.native, seq_len=args.seq_len,
                         max_per_class=args.max_per_class,
+                        label_offset=args.label_offset,
                         streaming=args.streaming, augment=args.augment,
                         fast_decode=args.fast_decode),
         optimizer=OptimizerConfig(name=args.optimizer,
@@ -328,6 +333,18 @@ def bert_vocab_file(data_dir: str | None) -> str | None:
     return p if os.path.exists(p) else None
 
 
+def _imagenet_val(data_dir: str, label_offset: int = 0) -> dict:
+    """Eager val split: TFRecord shards when present, else folder tree
+    (label_offset must match the train side's)."""
+    from ..data.tfrecord import split_shards
+    if split_shards(data_dir, "val"):
+        from ..data.imagenet import load_imagenet_tfrecords
+        return load_imagenet_tfrecords(data_dir, "val",
+                                       label_offset=label_offset)
+    from ..data.imagenet import load_imagenet_folder
+    return load_imagenet_folder(data_dir, "val")
+
+
 def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
     """Returns (train_arrays, eval_arrays) batch-keyed numpy dicts.
 
@@ -350,8 +367,7 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
             f"dataset {name!r} does not decode JPEGs")
     if eval_only and name in IMAGENET_DATASETS \
             and not cfg.data.synthetic and cfg.data.data_dir:
-        from ..data.imagenet import load_imagenet_folder
-        v = load_imagenet_folder(cfg.data.data_dir, "val")
+        v = _imagenet_val(cfg.data.data_dir, cfg.data.label_offset)
         return None, {"x": v["val_x"], "y": v["val_y"]}
     if name in ("mlp", "pipe_mlp", "mnist", "lenet"):
         from ..data.mnist import get_mnist
@@ -368,15 +384,16 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
             # train split streams (decode-per-batch, bounded memory); the
             # eval split stays an eager array dict — UNCAPPED, same as the
             # eager path: eval numbers must be comparable regardless of
-            # the train cap (see data/imagenet.py get_imagenet)
-            from ..data.imagenet import load_imagenet_folder
+            # the train cap (see data/imagenet.py get_imagenet). Both
+            # splits auto-detect TFRecord shards vs a folder tree
             from ..data.streaming import StreamingSource
             train_src = StreamingSource(
                 cfg.data.data_dir, "train",
                 max_per_class=cfg.data.max_per_class,
                 augment=cfg.data.augment,
-                fast_decode=cfg.data.fast_decode)
-            v = load_imagenet_folder(cfg.data.data_dir, "val")
+                fast_decode=cfg.data.fast_decode,
+                label_offset=cfg.data.label_offset)
+            v = _imagenet_val(cfg.data.data_dir, cfg.data.label_offset)
             return train_src, {"x": v["val_x"], "y": v["val_y"]}
         for flag, on in (("--augment", cfg.data.augment),
                          ("--fast_decode", cfg.data.fast_decode)):
@@ -387,6 +404,13 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
                     f"{flag} is not supported with --synthetic"
                     if cfg.data.synthetic or not cfg.data.data_dir
                     else f"{flag} requires --streaming")
+        if cfg.data.data_dir and not cfg.data.synthetic:
+            from ..data.tfrecord import split_shards
+            if split_shards(cfg.data.data_dir, "train"):
+                raise SystemExit(
+                    "TFRecord ImageNet shards stream per batch — pass "
+                    "--streaming (the eager path would decode the whole "
+                    "train split into RAM)")
         from ..data.imagenet import get_imagenet
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)

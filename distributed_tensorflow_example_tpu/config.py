@@ -29,6 +29,9 @@ class DataConfig:
     native: bool = False            # C++ loader (data/native.py) when built;
                                     # falls back to Python when unavailable
     max_per_class: int | None = None  # cap eager folder-tree decode (ImageNet)
+    label_offset: int = 0           # TFRecord image shards: added to
+                                    # every label (tf-slim ImageNet
+                                    # writes 1-indexed labels: pass -1)
     streaming: bool = False         # decode-per-batch thread-pool pipeline
                                     # (data/streaming.py) instead of eager
                                     # whole-split decode — ImageNet scale

@@ -25,11 +25,23 @@ def _list_classes(split_dir: str) -> list[str]:
                   if os.path.isdir(os.path.join(split_dir, d)))
 
 
+def _open_image(src):
+    """PIL open from a path OR encoded bytes (TFRecord 'image/encoded'
+    features decode through the same routine as files)."""
+    import io
+
+    from PIL import Image
+    if isinstance(src, (bytes, bytearray, memoryview)):
+        return Image.open(io.BytesIO(src))
+    return Image.open(src)
+
+
 def decode_image(path: str, image_size: int, *,
                  fast: bool = False) -> np.ndarray:
     """Decode + short-side resize + center crop -> [S,S,3] f32 in [0,1].
     The one decode routine shared by the eager loader and the streaming
     pipeline so both produce bit-identical pixels (with ``fast=False``).
+    ``path`` may also be the encoded image bytes (TFRecord path).
 
     ``fast=True`` enables JPEG DCT-domain downscaling (``Image.draft``):
     libjpeg decodes at 1/2–1/8 scale directly when the source is much
@@ -37,8 +49,7 @@ def decode_image(path: str, image_size: int, *,
     1024×768 sources for a ~0.016 mean-pixel deviation. Opt-in because
     the pixel stream differs from the plain decode.
     """
-    from PIL import Image
-    img = Image.open(path)
+    img = _open_image(path)
     if fast:
         img.draft("RGB", (image_size, image_size))
     img = img.convert("RGB")
@@ -61,9 +72,9 @@ def augment_image(path: str, image_size: int,
     Determinism: the caller derives ``rng`` from (seed, epoch, global
     image index), so the augmented pixel stream is independent of process
     count and batch composition, and exact-resume replays it bit-exactly.
+    ``path`` may also be the encoded image bytes (TFRecord path).
     """
-    from PIL import Image
-    img = Image.open(path)
+    img = _open_image(path)
     if fast:
         # DCT-scale decode — but conservatively: random-resized crop may
         # take as little as 8% of the area (a 0.283x-short-side window),
@@ -138,6 +149,37 @@ def load_imagenet_folder(data_dir: str, split: str = "train", *,
                                        max_per_class=max_per_class)
     xs = [decode_image(p, image_size) for p in paths]
     return {f"{split}_x": np.stack(xs), f"{split}_y": labels}
+
+
+def load_imagenet_tfrecords(data_dir: str, split: str = "val", *,
+                            image_size: int = 224,
+                            max_images: int | None = None,
+                            label_offset: int = 0
+                            ) -> dict[str, np.ndarray]:
+    """Eagerly decode image TFRecord shards (the classic
+    ``validation-00000-of-00128`` distribution format) into arrays —
+    the eval-split counterpart of the streaming TFRecord pipeline.
+    Records are ``tf.train.Example`` with ``image/encoded`` +
+    ``image/class/label``; ``label_offset`` must match the train
+    side's (tf-slim shards are 1-indexed: pass -1)."""
+    from .tfrecord import (decode_example, extract_image_label,
+                           split_shards, tfrecord_iterator)
+    shards = split_shards(data_dir, split)
+    if not shards:
+        raise FileNotFoundError(
+            f"no {split} TFRecord shards under {data_dir!r}")
+    xs, ys = [], []
+    for path in shards:
+        for rec in tfrecord_iterator(path):
+            img, label = extract_image_label(decode_example(rec))
+            xs.append(decode_image(img, image_size))
+            ys.append(label + label_offset)
+            if max_images is not None and len(xs) >= max_images:
+                break
+        if max_images is not None and len(xs) >= max_images:
+            break
+    return {f"{split}_x": np.stack(xs),
+            f"{split}_y": np.asarray(ys, np.int32)}
 
 
 def synthetic_imagenet(num_train: int = 512, num_test: int = 128,

@@ -132,15 +132,186 @@ class StreamingImageFolder:
         self._pool.shutdown(wait=False)
 
 
+class StreamingTFRecordImages:
+    """Lazily-decoded image TFRecord shards — the classic
+    ``train-00000-of-01024`` ImageNet distribution format: records are
+    ``tf.train.Example`` with ``image/encoded`` (JPEG bytes) and
+    ``image/class/label``. Same iteration surface and determinism
+    contract as :class:`StreamingImageFolder`.
+
+    The startup index pass reads only record OFFSETS (the C++ scanner
+    when built — no Python per record, no payload parse); labels arrive
+    with each batch's record reads. Random access over the shard set
+    gives the same seeded global shuffle as the folder pipeline —
+    no shuffle-buffer approximation.
+    """
+
+    #: per-thread cap on cached shard handles: with 1024 shards and a
+    #: global shuffle every thread would otherwise accumulate a handle
+    #: per shard and blow the FD limit mid-epoch
+    MAX_OPEN_PER_THREAD = 16
+
+    def __init__(self, data_dir: str, split: str = "train", *,
+                 image_size: int = 224,
+                 global_batch: int = 128,
+                 process_index: int = 0, num_processes: int = 1,
+                 shuffle: bool = True, seed: int = 0,
+                 decode_threads: int = 8,
+                 augment: bool = False,
+                 fast_decode: bool = False,
+                 label_offset: int = 0):
+        if global_batch % num_processes:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{num_processes} processes")
+        from .tfrecord import split_shards
+        self.shards = split_shards(data_dir, split)
+        if not self.shards:
+            raise FileNotFoundError(
+                f"no {split} TFRecord shards under {data_dir!r}")
+        self._offsets: list[np.ndarray] = []
+        self._lengths: list[np.ndarray] = []
+        shard_ids = []
+        slots = []
+        for si, path in enumerate(self.shards):
+            offs, lens = _shard_index(path)
+            self._offsets.append(offs)
+            self._lengths.append(lens)
+            shard_ids.append(np.full(len(offs), si, np.int32))
+            slots.append(np.arange(len(offs), dtype=np.int64))
+        self._shard_of = np.concatenate(shard_ids)
+        self._slot_of = np.concatenate(slots)
+        self.n = len(self._shard_of)
+        if self.n < global_batch:
+            raise ValueError(
+                f"split {split!r} has {self.n} records < global_batch "
+                f"{global_batch}")
+        self.image_size = image_size
+        self.global_batch = global_batch
+        self.local_batch = global_batch // num_processes
+        self.process_index = process_index
+        self.num_processes = num_processes
+        self.shuffle = shuffle
+        self.seed = seed
+        self.augment = augment
+        self.fast_decode = fast_decode
+        self.label_offset = label_offset
+        self.epoch = 0
+        self._pool = ThreadPoolExecutor(max_workers=max(1, decode_threads))
+        import threading
+        self._tls = threading.local()     # per-thread LRU of shard handles
+        self._open_lock = threading.Lock()
+        self._open_files: "list" = []     # all live handles, for close()
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.n // self.global_batch
+
+    def _read_record(self, i: int) -> bytes:
+        from collections import OrderedDict
+        si = int(self._shard_of[i])
+        slot = int(self._slot_of[i])
+        files = getattr(self._tls, "files", None)
+        if files is None:
+            files = self._tls.files = OrderedDict()
+        f = files.get(si)
+        if f is None:
+            f = open(self.shards[si], "rb")
+            files[si] = f
+            with self._open_lock:
+                self._open_files.append(f)
+            if len(files) > self.MAX_OPEN_PER_THREAD:
+                _, victim = files.popitem(last=False)
+                with self._open_lock:
+                    if victim in self._open_files:
+                        self._open_files.remove(victim)
+                victim.close()
+        else:
+            files.move_to_end(si)
+        f.seek(int(self._offsets[si][slot]))
+        return f.read(int(self._lengths[si][slot]))
+
+    def _example(self, i: int):
+        from .tfrecord import decode_example, extract_image_label
+        img, label = extract_image_label(
+            decode_example(self._read_record(i)))
+        return img, label + self.label_offset
+
+    def _decode(self, indices: np.ndarray, epoch: int) -> Batch:
+        def one(i):
+            img_bytes, label = self._example(int(i))
+            if self.augment:
+                rng = np.random.default_rng([self.seed, epoch, int(i)])
+                x = augment_image(img_bytes, self.image_size, rng,
+                                  fast=self.fast_decode)
+            else:
+                x = decode_image(img_bytes, self.image_size,
+                                 fast=self.fast_decode)
+            return x, label
+
+        out = list(self._pool.map(one, indices))
+        return {"x": np.stack([x for x, _ in out]),
+                "y": np.asarray([y for _, y in out], np.int32)}
+
+    def epoch_batches(self, epoch: int | None = None,
+                      start: int = 0) -> Iterator[Batch]:
+        epoch = self.epoch if epoch is None else epoch
+        idx = np.arange(self.n)
+        if self.shuffle:
+            np.random.RandomState((self.seed, epoch)).shuffle(idx)
+        for b in range(start, self.steps_per_epoch):
+            g0 = b * self.global_batch
+            gidx = idx[g0:g0 + self.global_batch]
+            l0 = self.process_index * self.local_batch
+            yield self._decode(gidx[l0:l0 + self.local_batch], epoch)
+
+    def skip(self, start_step: int) -> None:
+        self.epoch = start_step // self.steps_per_epoch
+        self._start_batch = start_step % self.steps_per_epoch
+
+    _start_batch = 0
+
+    def __iter__(self) -> Iterator[Batch]:
+        start, self._start_batch = self._start_batch, 0
+        while True:
+            yield from self.epoch_batches(self.epoch, start=start)
+            start = 0
+            self.epoch += 1
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        with self._open_lock:
+            for f in self._open_files:
+                f.close()
+            self._open_files.clear()
+
+
+def _shard_index(path: str):
+    """(data_offsets, data_lengths) for one shard: the C++ scanner when
+    built, else a pure-Python header scan — both seek past payloads, so
+    indexing cost scales with record count, not dataset bytes."""
+    from . import native
+    if native.available():
+        return native.tfrecord_index(path)
+    from .tfrecord import index_record_offsets
+    return index_record_offsets(path)
+
+
 class StreamingSource:
     """Trainer-pluggable data source (duck-typed alternative to the
     batch-keyed numpy dict): the Trainer calls :meth:`make_loader` with its
-    sharding coordinates instead of wrapping arrays in a ShardedLoader."""
+    sharding coordinates instead of wrapping arrays in a ShardedLoader.
+
+    Backed by an image-folder tree OR TFRecord shards — auto-detected
+    from the directory contents (``{split}*.tfrecord`` present wins).
+    """
 
     def __init__(self, data_dir: str, split: str = "train", *,
                  image_size: int = 224, max_per_class: int | None = None,
                  prefetch: int = 2, decode_threads: int = 8,
-                 augment: bool = False, fast_decode: bool = False):
+                 augment: bool = False, fast_decode: bool = False,
+                 label_offset: int = 0):
+        from .tfrecord import split_shards
         self.data_dir = data_dir
         self.split = split
         self.image_size = image_size
@@ -149,7 +320,9 @@ class StreamingSource:
         self.decode_threads = decode_threads
         self.augment = augment
         self.fast_decode = fast_decode
-        self._folder: StreamingImageFolder | None = None
+        self.label_offset = label_offset
+        self.tfrecords = bool(split_shards(data_dir, split))
+        self._folder = None    # StreamingImageFolder | StreamingTFRecordImages
 
     def make_loader(self, global_batch: int, *, start_step: int = 0,
                     process_index: int = 0, num_processes: int = 1,
@@ -157,12 +330,32 @@ class StreamingSource:
                     prefetch: int | None = None, **_unused) -> Iterator[Batch]:
         if self._folder is not None:      # re-entry: release the previous
             self._folder.close()          # decode pool, don't leak it
-        self._folder = StreamingImageFolder(
-            self.data_dir, self.split, image_size=self.image_size,
-            max_per_class=self.max_per_class, global_batch=global_batch,
-            process_index=process_index, num_processes=num_processes,
-            shuffle=shuffle, seed=seed, decode_threads=self.decode_threads,
-            augment=self.augment, fast_decode=self.fast_decode)
+        if self.tfrecords:
+            if self.max_per_class is not None:
+                raise ValueError(
+                    "--max_per_class applies to the folder pipeline; "
+                    "TFRecord shards carry no class layout to cap")
+            self._folder = StreamingTFRecordImages(
+                self.data_dir, self.split, image_size=self.image_size,
+                global_batch=global_batch,
+                process_index=process_index, num_processes=num_processes,
+                shuffle=shuffle, seed=seed,
+                decode_threads=self.decode_threads,
+                augment=self.augment, fast_decode=self.fast_decode,
+                label_offset=self.label_offset)
+        else:
+            if self.label_offset:
+                raise ValueError(
+                    "label_offset is a TFRecord-shard knob (tf-slim "
+                    "1-indexed labels); the folder tree derives labels "
+                    "from directory order")
+            self._folder = StreamingImageFolder(
+                self.data_dir, self.split, image_size=self.image_size,
+                max_per_class=self.max_per_class, global_batch=global_batch,
+                process_index=process_index, num_processes=num_processes,
+                shuffle=shuffle, seed=seed,
+                decode_threads=self.decode_threads,
+                augment=self.augment, fast_decode=self.fast_decode)
         if start_step > 0:
             self._folder.skip(start_step)
         it = iter(self._folder)

@@ -24,6 +24,7 @@ asserted by oracle tests against the installed TF wheel
 from __future__ import annotations
 
 import os
+import re
 import struct
 from typing import Any, Iterator
 
@@ -399,3 +400,78 @@ def find_tfrecords(data_dir: str, prefix: str = "") -> "list[str]":
         return []
     return [os.path.join(data_dir, n) for n in names
             if n.startswith(prefix) and n.endswith(".tfrecord")]
+
+
+def split_shards(data_dir: str, split: str) -> "list[str]":
+    """Shard files for a dataset split. Accepts BOTH spellings in the
+    wild: ``{split}*.tfrecord`` and the classic extensionless
+    ``{split}-00000-of-01024`` (tf-slim/tfds ImageNet shards carry no
+    suffix); the tf-slim ``validation-*`` naming satisfies ``val``."""
+    def matching(prefix: str) -> "list[str]":
+        try:
+            names = sorted(os.listdir(data_dir))
+        except OSError:
+            return []
+        pat = re.compile(
+            rf"{re.escape(prefix)}(-\d+-of-\d+(\.tfrecord)?"
+            rf"|.*\.tfrecord)$")
+        return [os.path.join(data_dir, n) for n in names
+                if pat.fullmatch(n)]
+
+    shards = matching(split)
+    if not shards and split == "val":
+        shards = matching("validation")
+    return shards
+
+
+#: accepted Example feature-key spellings (tf-slim / tfds image exports)
+IMAGE_KEYS = ("image/encoded", "image")
+LABEL_KEYS = ("image/class/label", "label")
+
+
+def extract_image_label(example: dict) -> tuple[bytes, int]:
+    """(encoded image bytes, integer label) from a decoded image
+    Example — the one probing helper shared by the streaming and eager
+    loaders."""
+    img = label = None
+    for k in IMAGE_KEYS:
+        if k in example:
+            img = example[k][0]              # BytesList -> first entry
+            break
+    for k in LABEL_KEYS:
+        if k in example:
+            label = int(np.asarray(example[k]).reshape(-1)[0])
+            break
+    if img is None or label is None:
+        raise ValueError(
+            f"record lacks image/label features (has {sorted(example)}; "
+            f"wanted one of {IMAGE_KEYS} and one of {LABEL_KEYS})")
+    return img, label
+
+
+def index_record_offsets(path: str) -> "tuple[np.ndarray, np.ndarray]":
+    """(data_offsets, data_lengths) for a TFRecord file by header scan
+    only — seeks past payloads, so indexing cost scales with record
+    COUNT, not dataset bytes (the C++ scanner in data/native.py does the
+    same off the GIL; this is the pure-Python fallback)."""
+    size = os.path.getsize(path)
+    offs: list[int] = []
+    lens: list[int] = []
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            header = f.read(12)
+            if not header:
+                break
+            if len(header) != 12:
+                raise ValueError(f"{path}: truncated record header")
+            pos += 12
+            (length,) = struct.unpack("<Q", header[:8])
+            remaining = size - pos
+            if remaining < 4 or length > remaining - 4:
+                raise ValueError(f"{path}: truncated record data")
+            offs.append(pos)
+            lens.append(length)
+            pos += length + 4
+            f.seek(pos)
+    return np.asarray(offs, np.int64), np.asarray(lens, np.int64)

@@ -1,0 +1,249 @@
+"""Image TFRecord input (the classic sharded ImageNet distribution).
+
+Contract: TFRecord shards of Examples (image/encoded JPEG +
+image/class/label) feed the streaming pipeline with the SAME iteration
+surface and determinism guarantees as the folder tree — seeded global
+shuffle, process-count independence, exact-resume — and the eval split
+loads eagerly through the same decode routine.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.data.imagenet import (
+    decode_image, load_imagenet_tfrecords)
+from distributed_tensorflow_example_tpu.data.streaming import (
+    StreamingSource, StreamingTFRecordImages)
+from distributed_tensorflow_example_tpu.data.tfrecord import (
+    encode_example, TFRecordWriter, split_shards)
+
+SIZE = 64
+
+
+def _jpeg(color, size=96) -> bytes:
+    from PIL import Image
+    arr = np.zeros((size, size, 3), np.uint8)
+    arr[..., :] = color
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def tfrec_dir(tmp_path_factory):
+    """2 train shards (12 records) + 1 validation shard (4 records);
+    label i is a distinct solid color so pixels identify records."""
+    d = tmp_path_factory.mktemp("imagenet_tfrec")
+    colors = [(255, 0, 0), (0, 255, 0), (0, 0, 255), (255, 255, 0)]
+
+    def example(label):
+        return {"image/encoded": [_jpeg(colors[label])],
+                "image/class/label": np.asarray([label], np.int64)}
+
+    labels = [i % 4 for i in range(12)]
+    with TFRecordWriter(str(d / "train-00000-of-00002.tfrecord")) as w:
+        for lab in labels[:6]:
+            w.write(encode_example(example(lab)))
+    with TFRecordWriter(str(d / "train-00001-of-00002.tfrecord")) as w:
+        for lab in labels[6:]:
+            w.write(encode_example(example(lab)))
+    with TFRecordWriter(str(d / "validation-00000-of-00001.tfrecord")) as w:
+        for lab in labels[:4]:
+            w.write(encode_example(example(lab)))
+    return str(d), labels, colors
+
+
+def test_streaming_tfrecords_yields_correct_images(tfrec_dir):
+    d, labels, colors = tfrec_dir
+    src = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                  global_batch=4, shuffle=False,
+                                  decode_threads=2)
+    assert src.n == 12 and src.steps_per_epoch == 3
+    batch = next(iter(src))
+    assert batch["x"].shape == (4, SIZE, SIZE, 3)
+    np.testing.assert_array_equal(batch["y"], labels[:4])
+    # pixels equal the shared decode routine on the same bytes
+    want = decode_image(_jpeg(colors[labels[0]]), SIZE)
+    np.testing.assert_array_equal(batch["x"][0], want)
+    src.close()
+
+
+def test_process_count_independence(tfrec_dir):
+    d, _, _ = tfrec_dir
+    one = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                  global_batch=4, shuffle=True, seed=3,
+                                  decode_threads=2)
+    b_one = next(iter(one))
+    parts = []
+    for p in range(2):
+        two = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                      global_batch=4, shuffle=True,
+                                      seed=3, process_index=p,
+                                      num_processes=2, decode_threads=2)
+        parts.append(next(iter(two)))
+        two.close()
+    np.testing.assert_array_equal(
+        b_one["x"], np.concatenate([p["x"] for p in parts]))
+    np.testing.assert_array_equal(
+        b_one["y"], np.concatenate([p["y"] for p in parts]))
+    one.close()
+
+
+def test_exact_resume_skip(tfrec_dir):
+    d, _, _ = tfrec_dir
+    ref = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                  global_batch=4, seed=1,
+                                  decode_threads=2)
+    it = iter(ref)
+    batches = [next(it) for _ in range(5)]      # crosses an epoch edge
+    resumed = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                      global_batch=4, seed=1,
+                                      decode_threads=2)
+    resumed.skip(3)
+    it2 = iter(resumed)
+    for k in (3, 4):
+        got = next(it2)
+        np.testing.assert_array_equal(got["x"], batches[k]["x"])
+        np.testing.assert_array_equal(got["y"], batches[k]["y"])
+    ref.close()
+    resumed.close()
+
+
+def test_streaming_source_autodetects(tfrec_dir):
+    d, labels, _ = tfrec_dir
+    src = StreamingSource(d, "train", image_size=SIZE)
+    assert src.tfrecords
+    loader = src.make_loader(4, shuffle=False, prefetch=0)
+    batch = next(loader)
+    np.testing.assert_array_equal(batch["y"], labels[:4])
+    src.close()
+    # max_per_class is a folder-tree knob: hard error, not a silent no-op
+    capped = StreamingSource(d, "train", image_size=SIZE, max_per_class=5)
+    with pytest.raises(ValueError, match="max_per_class"):
+        capped.make_loader(4)
+
+
+def test_eager_val_split(tfrec_dir):
+    d, labels, colors = tfrec_dir
+    v = load_imagenet_tfrecords(d, "val", image_size=SIZE)
+    assert v["val_x"].shape == (4, SIZE, SIZE, 3)
+    np.testing.assert_array_equal(v["val_y"], labels[:4])
+    want = decode_image(_jpeg(colors[labels[1]]), SIZE)
+    np.testing.assert_array_equal(v["val_x"][1], want)
+    # 'validation-*' shards satisfy the 'val' split (tf-slim spelling)
+    assert split_shards(d, "val")
+
+
+def test_augment_path_runs(tfrec_dir):
+    d, _, _ = tfrec_dir
+    src = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                  global_batch=4, augment=True, seed=5,
+                                  decode_threads=2)
+    b1 = next(iter(src))
+    assert b1["x"].shape == (4, SIZE, SIZE, 3)
+    # deterministic: same seed reproduces the augmented pixels
+    src2 = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                   global_batch=4, augment=True, seed=5,
+                                   decode_threads=2)
+    np.testing.assert_array_equal(b1["x"], next(iter(src2))["x"])
+    src.close()
+    src2.close()
+
+
+def test_cli_imagenet_val_autodetect(tfrec_dir):
+    d, labels, _ = tfrec_dir
+    from distributed_tensorflow_example_tpu.cli.train import _imagenet_val
+    v = _imagenet_val(d)
+    np.testing.assert_array_equal(v["val_y"], labels[:4])
+
+
+def test_cli_eager_tfrecords_requires_streaming(tfrec_dir):
+    d, _, _ = tfrec_dir
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="streaming"):
+        main(["--model", "resnet50", "--train_steps", "1",
+              "--data_dir", d])
+
+
+def test_extensionless_classic_shard_names(tmp_path):
+    """Real tf-slim/tfds shards are named train-00000-of-01024 with NO
+    .tfrecord suffix — detection and streaming must accept them."""
+    colors = [(200, 0, 0), (0, 200, 0)]
+    with TFRecordWriter(str(tmp_path / "train-00000-of-00002")) as w:
+        for i in range(3):
+            w.write(encode_example(
+                {"image/encoded": [_jpeg(colors[i % 2])],
+                 "image/class/label": np.asarray([i % 2], np.int64)}))
+    with TFRecordWriter(str(tmp_path / "train-00001-of-00002")) as w:
+        w.write(encode_example(
+            {"image/encoded": [_jpeg(colors[1])],
+             "image/class/label": np.asarray([1], np.int64)}))
+    with TFRecordWriter(str(tmp_path / "validation-00000-of-00001")) as w:
+        w.write(encode_example(
+            {"image/encoded": [_jpeg(colors[0])],
+             "image/class/label": np.asarray([0], np.int64)}))
+    assert len(split_shards(str(tmp_path), "train")) == 2
+    assert len(split_shards(str(tmp_path), "val")) == 1
+    src = StreamingTFRecordImages(str(tmp_path), "train", image_size=SIZE,
+                                  global_batch=4, shuffle=False,
+                                  decode_threads=1)
+    batch = next(iter(src))
+    np.testing.assert_array_equal(batch["y"], [0, 1, 0, 1])
+    src.close()
+    # random files must NOT be picked up as shards
+    (tmp_path / "train_notes.txt").write_text("x")
+    assert len(split_shards(str(tmp_path), "train")) == 2
+
+
+def test_label_offset_applied_consistently(tfrec_dir):
+    d, labels, _ = tfrec_dir
+    src = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                  global_batch=4, shuffle=False,
+                                  decode_threads=1, label_offset=-1)
+    np.testing.assert_array_equal(next(iter(src))["y"],
+                                  np.asarray(labels[:4]) - 1)
+    src.close()
+    v = load_imagenet_tfrecords(d, "val", image_size=SIZE,
+                                label_offset=-1)
+    np.testing.assert_array_equal(v["val_y"], np.asarray(labels[:4]) - 1)
+    # folder pipeline rejects the knob instead of ignoring it
+    src2 = StreamingSource(str(d), "nosuchsplit", label_offset=-1)
+    assert not src2.tfrecords
+    with pytest.raises((ValueError, FileNotFoundError)):
+        src2.make_loader(4)
+
+
+def test_fd_cap_and_close(tfrec_dir):
+    d, _, _ = tfrec_dir
+    src = StreamingTFRecordImages(d, "train", image_size=SIZE,
+                                  global_batch=4, seed=2,
+                                  decode_threads=2)
+    it = iter(src)
+    for _ in range(4):
+        next(it)
+    assert len(src._open_files) <= 2 * src.MAX_OPEN_PER_THREAD
+    handles = list(src._open_files)
+    src.close()
+    assert not src._open_files
+    assert all(f.closed for f in handles)
+
+
+def test_python_index_matches_native(tfrec_dir):
+    """The seek-based pure-Python header scan agrees with the C++
+    scanner (and with TFRecordFile)."""
+    from distributed_tensorflow_example_tpu.data import native
+    from distributed_tensorflow_example_tpu.data.tfrecord import (
+        TFRecordFile, index_record_offsets)
+    d, _, _ = tfrec_dir
+    path = split_shards(d, "train")[0]
+    offs, lens = index_record_offsets(path)
+    with TFRecordFile(path) as f:
+        np.testing.assert_array_equal(offs, f._offsets)
+        np.testing.assert_array_equal(lens, f._lengths)
+    if native.available():
+        n_offs, n_lens = native.tfrecord_index(path)
+        np.testing.assert_array_equal(offs, n_offs)
+        np.testing.assert_array_equal(lens, n_lens)
