@@ -98,6 +98,11 @@ class StepCounterHook(Hook):
         self.last_rates = self.tracker.rates(step)
         if not self.last_rates or not _is_chief():
             return
+        # the reference-era dashboards always carried a learning_rate
+        # scalar next to steps/sec; the schedule is host-evaluable
+        lr = getattr(trainer, "learning_rate_at", None)
+        if lr is not None:
+            self.last_rates["learning_rate"] = lr(step)
         log.info("step %d: %.1f steps/s, %s", step,
                  self.last_rates["steps_per_sec"],
                  (f"{self.last_rates['examples_per_sec_per_chip']:.1f} "

@@ -35,7 +35,7 @@ from ..parallel.sync_replicas import SyncReplicas
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsLogger
 from . import hooks as hooks_lib
-from .optimizers import find_ema_params, make_optimizer
+from .optimizers import find_ema_params, make_optimizer, make_schedule
 from .state import TrainState, param_count
 
 log = get_logger("trainer")
@@ -76,6 +76,7 @@ class Trainer:
             # attention binds a mesh via attention_fn)
             model.bind_mesh(self.mesh)
         self.tx = make_optimizer(config.optimizer)
+        self._schedule = make_schedule(config.optimizer)
         rules = model.sharding_rules(config.mesh)
         self.sync = SyncReplicas(model.loss, self.tx, self.mesh,
                                  sync=config.sync, rules=rules,
@@ -157,6 +158,16 @@ class Trainer:
             hs.append(hooks_lib.ProfilerHook(cfg.obs.profile_dir,
                                              *cfg.obs.profile_steps))
         return hs
+
+    # ------------------------------------------------------------------
+    def learning_rate_at(self, step: int) -> float:
+        """The LR applied by the update that PRODUCED completed step
+        ``step`` (optax evaluates the schedule at the pre-increment
+        count, i.e. ``sched(step - 1)``) — so a metrics record at step N
+        correlates with the LR that actually scaled step N's gradients.
+        Logged next to steps/sec like the reference era's learning_rate
+        summary."""
+        return float(self._schedule(max(0, step - 1)))
 
     # ------------------------------------------------------------------
     def initialize(self) -> TrainState:

@@ -14,6 +14,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_tensorflow_example_tpu.config import (DataConfig,
                                                        MeshShape,
@@ -174,3 +175,34 @@ def test_metrics_stream_opens_with_full_config(tmp_path):
     assert len(configs) == 2
     assert configs[1]["config"]["optimizer"]["learning_rate"] == 0.1
     assert configs[1]["start_step"] == 10
+
+
+def test_learning_rate_logged_with_rates(tmp_path):
+    """The metrics stream carries the LR that actually scaled each
+    logged step's gradients (the reference era's learning_rate summary;
+    optax evaluates the schedule at the pre-increment count, so step N
+    used sched(N-1))."""
+    data = synthetic_mnist(256, 64)
+    jpath = str(tmp_path / "m.jsonl")
+    cfg = TrainConfig(model="mlp", train_steps=4,
+                      data=DataConfig(batch_size=64),
+                      optimizer=OptimizerConfig(
+                          name="sgd", learning_rate=0.5,
+                          decay_schedule="polynomial", total_steps=4),
+                      obs=ObservabilityConfig(log_every_steps=2,
+                                              metrics_path=jpath))
+    tr = Trainer(get_model("mlp", cfg), cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    tr.train()
+    tr.close()
+    recs = [json.loads(l) for l in open(jpath)]
+    lrs = {r["step"]: r["learning_rate"] for r in recs
+           if "learning_rate" in r}
+    assert lrs, recs
+    # polynomial over 4 steps: the step-2 update used sched(1) = 0.375,
+    # the step-4 update used sched(3) = 0.125
+    assert lrs[2] == pytest.approx(0.375)
+    assert lrs[4] == pytest.approx(0.125)
+    assert tr.learning_rate_at(1) == pytest.approx(0.5)   # sched(0)
