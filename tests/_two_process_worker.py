@@ -144,6 +144,25 @@ def main() -> int:
             np.asarray(multihost_utils.process_allgather(b, tiled=True)))
     rt.barrier("sharded-ok")
 
+    # --- warm start across processes (init_from_checkpoint parity) ----
+    # every process loads the monolithic checkpoint from the shared fs
+    # and places values onto the cross-process fsdp shardings; the
+    # warmed params must equal the checkpoint bytes on every process
+    from distributed_tensorflow_example_tpu.ckpt.warm_start import (
+        load_checkpoint_arrays, warm_start)
+    fresh = sync.init(model.init, seed=99)
+    warmed, report = warm_start(fresh.params, ckpt_dir)
+    assert not report.fresh, report
+    saved = load_checkpoint_arrays(ckpt_dir)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(warmed)[0]:
+        from distributed_tensorflow_example_tpu.utils.pytree import (
+            path_str)
+        got = np.asarray(multihost_utils.process_allgather(leaf,
+                                                           tiled=True))
+        np.testing.assert_array_equal(
+            got, saved["params/" + path_str(path)])
+    rt.barrier("warm-start-ok")
+
     flat = jax.tree_util.tree_leaves(state.params)
     host = [np.asarray(multihost_utils.process_allgather(p, tiled=True))
             for p in flat]
