@@ -37,6 +37,7 @@ a full leaf only when the piece layout and the target sharding disagree
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import tempfile
@@ -337,7 +338,9 @@ class CheckpointManager:
         self.wait()                # async write may not have landed yet
         st = self._state()
         steps = []
-        for p in st["all_model_checkpoint_paths"] + st.get("kept_forever", []):
+        best = [st["best"]["path"]] if st.get("best") else []
+        for p in (st["all_model_checkpoint_paths"]
+                  + st.get("kept_forever", []) + best):
             m = re.search(rf"{PREFIX}-(\d+)\.(npz|shards\.json)$", p)
             if m and os.path.exists(os.path.join(self.directory, p)):
                 steps.append(int(m.group(1)))
@@ -420,10 +423,11 @@ class CheckpointManager:
         """Record anchor ``base`` in the state file + rotate the ring."""
         st = self._state()
         now = time.time()
-        # a step may only live in ONE list: re-saving an existing step
-        # (end-of-run save after restore, or a ring entry promoted to
-        # kept-forever) must not leave a stale entry behind — ring
-        # rotation would os.remove a file the other list still names
+        # a step may only live in ONE list (plus possibly the 'best'
+        # pointer): re-saving an existing step (end-of-run save after
+        # restore, or a ring entry promoted to kept-forever) must not
+        # leave a stale entry behind — ring rotation would os.remove a
+        # file the other list still names
         if base in st["all_model_checkpoint_paths"]:
             st["all_model_checkpoint_paths"].remove(base)
         was_kept = base in st.get("kept_forever", [])
@@ -442,6 +446,10 @@ class CheckpointManager:
             if other in st.get("kept_forever", []):
                 st["kept_forever"].remove(other)
                 was_kept = True       # kept-forever status follows the step
+            if other == (st.get("best") or {}).get("path"):
+                # the best pointer follows a format re-save of its step —
+                # the evicted anchor must not leave it dangling
+                st["best"]["path"] = base
             self._remove_victim(other)
         if was_kept or (self.keep_every_n_hours > 0 and
                         now - self._last_kept_forever
@@ -454,9 +462,14 @@ class CheckpointManager:
         else:
             st["all_model_checkpoint_paths"].append(base)
         st["latest"] = base
-        # ring rotation (max_to_keep, saver.py:448 parity)
+        # ring rotation (max_to_keep, saver.py:448 parity); the 'best'
+        # checkpoint survives rotation — it leaves the ring list but
+        # its file stays until a better one supersedes it
         while len(st["all_model_checkpoint_paths"]) > self.max_to_keep:
-            self._remove_victim(st["all_model_checkpoint_paths"].pop(0))
+            victim = st["all_model_checkpoint_paths"].pop(0)
+            if victim == (st.get("best") or {}).get("path"):
+                continue
+            self._remove_victim(victim)
         self._write_state(st)
 
     def _write(self, arrays: dict[str, np.ndarray], step: int) -> str:
@@ -511,6 +524,64 @@ class CheckpointManager:
                 self._pending = self._executor.submit(write_and_commit)
             return shard_path
         return write_and_commit()
+
+    def save_best(self, state: PyTree, step: int, metric_value: float,
+                  *, mode: str = "max") -> bool:
+        """Save ``state`` as the new best iff ``metric_value`` improves
+        on the recorded best (tf.estimator BestExporter parity). The
+        best checkpoint survives ring rotation until superseded; a
+        superseded best that no other list references is deleted.
+        Collective like :meth:`save` — every process must call it; the
+        state-file bookkeeping is writer-only. Returns True when this
+        step became the best."""
+        if mode not in ("max", "min"):
+            raise ValueError(f"keep_best mode must be max|min, got {mode!r}")
+        self.wait()
+        value = float(metric_value)
+        best = self._state().get("best")
+        if math.isnan(value):
+            # a NaN 'best' would win every comparison forever
+            improved = False
+        elif best is None or math.isnan(best["value"]):
+            improved = True
+        else:
+            improved = (value > best["value"] if mode == "max"
+                        else value < best["value"])
+        if jax.process_count() > 1:
+            # the verdict must agree across hosts (save() is collective;
+            # a stale state-file read on a non-writer would deadlock at
+            # the gather) — the writer's view is authoritative, same as
+            # _agreed_latest_step
+            from jax.experimental import multihost_utils
+            improved = bool(multihost_utils.broadcast_one_to_all(
+                np.asarray(improved)))
+        if not improved:
+            return False
+        self.save(state, step)
+        if not self.is_writer:
+            return True
+        self.wait()                      # async save must land first
+        with self._lock:
+            st = self._state()
+            old = st.get("best")
+            base = os.path.basename(
+                self.checkpoint_path(step) if os.path.exists(
+                    self.checkpoint_path(step))
+                else self.shard_anchor_path(step))
+            st["best"] = {"path": base, "step": int(step),
+                          "value": value}
+            if (old and old["path"] != base
+                    and old["path"] not in st["all_model_checkpoint_paths"]
+                    and old["path"] not in st.get("kept_forever", [])):
+                self._remove_victim(old["path"])
+            self._write_state(st)
+        return True
+
+    def best_step(self) -> "int | None":
+        """Step of the best checkpoint (None when never recorded)."""
+        self.wait()
+        best = self._state().get("best")
+        return int(best["step"]) if best else None
 
     def restore(self, template: PyTree, step: int | None = None) -> PyTree:
         """Load ``step`` (default: latest) into the template's structure &

@@ -180,6 +180,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_steps", type=int, default=0)
     p.add_argument("--save_secs", type=float, default=0.0)
     p.add_argument("--max_to_keep", type=int, default=5)
+    p.add_argument("--keep_best_metric", default=None,
+                   help="track this eval metric and keep the best "
+                        "checkpoint outside the rotation ring "
+                        "(BestExporter parity; needs --eval_every_steps "
+                        "or a final eval)")
+    p.add_argument("--keep_best_mode", default="max",
+                   choices=["max", "min"],
+                   help="max (accuracy-like) or min (loss-like)")
     p.add_argument("--keep_checkpoint_every_n_hours", type=float, default=0.0,
                    help="pin one checkpoint outside the max_to_keep ring "
                         "every N hours (TF Saver semantics; 0 disables)")
@@ -303,6 +311,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             warm_start=args.warm_start,
             warm_start_map=args.warm_start_map,
             max_to_keep=args.max_to_keep,
+            keep_best_metric=args.keep_best_metric,
+            keep_best_mode=args.keep_best_mode,
             save_steps=args.save_steps,
             save_secs=args.save_secs,
             keep_checkpoint_every_n_hours=args.keep_checkpoint_every_n_hours,

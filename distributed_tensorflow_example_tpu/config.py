@@ -155,6 +155,11 @@ class CheckpointConfig:
     warm_start_map: str = ""        # 'ckpt_prefix:model_prefix' pairs,
                                     # comma-separated (assignment_map)
     max_to_keep: int = 5
+    keep_best_metric: str | None = None  # eval metric tracked for the
+                                         # 'best' checkpoint
+                                         # (BestExporter parity; needs
+                                         # an eval split)
+    keep_best_mode: str = "max"          # max (accuracy) | min (loss)
     save_steps: int = 0             # save every N steps (0 disables step-based)
     save_secs: float = 0.0          # save every T seconds (0 disables time-based)
     keep_checkpoint_every_n_hours: float = 0.0

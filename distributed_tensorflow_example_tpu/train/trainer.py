@@ -103,6 +103,13 @@ class Trainer:
         self.hooks = self._default_hooks() + list(hooks or [])
         self._eval_fn = None
 
+        if (config.checkpoint.keep_best_metric
+                and self.eval_arrays is None):
+            # fail fast: best tracking without an eval split would be a
+            # silent no-op (both save_best call sites are eval-gated)
+            raise ValueError(
+                "keep_best_metric needs eval data (none was provided)")
+
         k = config.steps_per_loop
         if k > 1:
             # hooks fire on step % cadence == 0; a K-step jump only lands on
@@ -308,6 +315,7 @@ class Trainer:
                     log.info("eval @ step %d: %s", step,
                              {k: round(v, 4) for k, v in ev.items()})
                     self.metrics_logger.log({"step": step, "eval": ev})
+                    self._maybe_save_best(state, step, ev)
 
             # block on the final step so hook teardown sees settled state
             jax.block_until_ready(state.params)
@@ -343,7 +351,25 @@ class Trainer:
                 k: float(v) for k, v in jax.device_get(device_metrics).items()}
         if self.eval_arrays is not None:
             summary["eval"] = self.evaluate(state)
+            self._maybe_save_best(state, step, summary["eval"])
         return state, summary
+
+    def _maybe_save_best(self, state: TrainState, step: int,
+                         ev: dict) -> None:
+        """BestExporter parity: track the best eval metric and keep its
+        checkpoint immune from ring rotation."""
+        metric = self.config.checkpoint.keep_best_metric
+        if not metric or self.ckpt_manager is None:
+            return
+        if metric not in ev:
+            raise ValueError(
+                f"keep_best_metric={metric!r} is not an eval metric "
+                f"(eval produced {sorted(ev)})")
+        if self.ckpt_manager.save_best(
+                state, step, float(ev[metric]),
+                mode=self.config.checkpoint.keep_best_mode):
+            log.info("new best %s=%.6f at step %d", metric,
+                     float(ev[metric]), step)
 
     # ------------------------------------------------------------------
     def close(self) -> None:

@@ -143,3 +143,110 @@ def test_bfloat16_roundtrip(tmp_path):
     assert out["b"].dtype == jnp.float32
     np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
                                   np.asarray(state["w"], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# best-checkpoint tracking (BestExporter parity)
+# ---------------------------------------------------------------------------
+
+def _mini_state(step, value):
+    from distributed_tensorflow_example_tpu.train.state import TrainState
+    return TrainState(step=jnp.asarray(step, jnp.int32),
+                      params={"w": jnp.full((2,), float(value))},
+                      opt_state={}, extras={}, rng=jax.random.key(0))
+
+
+def test_save_best_tracks_improvement(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.save_best(_mini_state(1, 1.0), 1, 0.5) is True
+    assert mgr.best_step() == 1
+    # worse: not saved as best (and no checkpoint written for step 2)
+    assert mgr.save_best(_mini_state(2, 2.0), 2, 0.4) is False
+    assert mgr.best_step() == 1
+    assert 2 not in mgr.all_steps()
+    # better: supersedes
+    assert mgr.save_best(_mini_state(3, 3.0), 3, 0.9) is True
+    assert mgr.best_step() == 3
+    # min mode flips the comparison
+    mgr2 = CheckpointManager(str(tmp_path / "min"))
+    assert mgr2.save_best(_mini_state(1, 1.0), 1, 0.5, mode="min")
+    assert mgr2.save_best(_mini_state(2, 2.0), 2, 0.8, mode="min") is False
+    assert mgr2.save_best(_mini_state(3, 3.0), 3, 0.1, mode="min")
+    with pytest.raises(ValueError, match="max|min"):
+        mgr2.save_best(_mini_state(4, 4.0), 4, 0.1, mode="bigger")
+
+
+def test_best_survives_ring_rotation(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    mgr.save_best(_mini_state(1, 1.0), 1, 0.9)       # best at step 1
+    for s in range(2, 7):
+        mgr.save(_mini_state(s, float(s)), s)        # rotate hard
+    assert mgr.best_step() == 1
+    # the best file still exists and restores, though outside the ring
+    restored = mgr.restore(_mini_state(0, 0.0), step=1)
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]),
+                                  [1.0, 1.0])
+    # superseding the best deletes the orphaned old best file
+    mgr.save_best(_mini_state(7, 7.0), 7, 0.95)
+    assert not os.path.exists(mgr.checkpoint_path(1))
+    assert mgr.best_step() == 7
+
+
+def test_trainer_keeps_best_checkpoint(tmp_path):
+    """End to end: an eval cadence + keep_best_metric records the best
+    step; a later worse eval does not displace it."""
+    from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                           DataConfig,
+                                                           OptimizerConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.data.mnist import (
+        synthetic_mnist)
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    data = synthetic_mnist(512, 128)
+    cfg = TrainConfig(model="mlp", train_steps=30, eval_every_steps=10,
+                      data=DataConfig(batch_size=64),
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.5),
+                      checkpoint=CheckpointConfig(
+                          directory=str(tmp_path / "ck"),
+                          keep_best_metric="accuracy"))
+    tr = Trainer(get_model("mlp", cfg), cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 eval_arrays={"x": data["test_x"], "y": data["test_y"]},
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    tr.train()
+    best = tr.ckpt_manager.best_step()
+    assert best is not None and best in tr.ckpt_manager.all_steps()
+    tr.close()
+
+    # best tracking without eval data fails fast at construction
+    with pytest.raises(ValueError, match="keep_best"):
+        Trainer(get_model("mlp", cfg), cfg,
+                {"x": data["train_x"], "y": data["train_y"]},
+                mesh=local_mesh(1, {"data": 1}),
+                process_index=0, num_processes=1)
+
+    # unknown metric is a hard error, not a silent no-op
+    cfg2 = cfg.replace(checkpoint=CheckpointConfig(
+        directory=str(tmp_path / "ck2"), keep_best_metric="bogus"))
+    tr2 = Trainer(get_model("mlp", cfg2), cfg2,
+                  {"x": data["train_x"], "y": data["train_y"]},
+                  eval_arrays={"x": data["test_x"], "y": data["test_y"]},
+                  mesh=local_mesh(1, {"data": 1}),
+                  process_index=0, num_processes=1)
+    with pytest.raises(ValueError, match="keep_best_metric"):
+        tr2.train()
+    tr2.close()
+
+
+def test_save_best_rejects_nan(tmp_path):
+    """A NaN metric must not become (or stay) the unbeatable best."""
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.save_best(_mini_state(1, 1.0), 1, float("nan")) is False
+    assert mgr.best_step() is None
+    assert mgr.save_best(_mini_state(2, 2.0), 2, 0.7) is True
+    assert mgr.best_step() == 2
