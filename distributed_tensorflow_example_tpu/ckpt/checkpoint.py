@@ -727,6 +727,25 @@ def _agreed_latest_step(manager: CheckpointManager) -> int | None:
     return chief_step
 
 
+def _agreed_best_step(manager: CheckpointManager) -> int | None:
+    """Best step agreed across ALL processes (chief's view broadcast +
+    local readability check — same contract as
+    :func:`_agreed_latest_step`, for the keep_best pointer)."""
+    local = manager.best_step()
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+    chief = int(multihost_utils.broadcast_one_to_all(
+        np.int64(-1 if local is None else local)))
+    chief_step = None if chief < 0 else chief
+    if chief_step is not None and not manager._anchor_exists(chief_step):
+        raise FileNotFoundError(
+            f"process {jax.process_index()} cannot read best checkpoint "
+            f"step {chief_step}: the checkpoint directory "
+            f"{manager.directory!r} must be a shared filesystem")
+    return chief_step
+
+
 def restore_or_init(manager: CheckpointManager | None, init_fn,
                     *args, **kwargs):
     """The prepare_session decision (session_manager.py:320-335 parity):

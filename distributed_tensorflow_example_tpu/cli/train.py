@@ -220,6 +220,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval_step", type=int, default=None,
                    help="checkpoint step to evaluate (--eval_only; "
                         "default: latest)")
+    p.add_argument("--eval_best", action="store_true",
+                   help="with --eval_only: evaluate (and, with "
+                        "--export_dir, export) the checkpoint the "
+                        "keep_best tracker recorded instead of latest")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--check_nans", action="store_true",
                    help="stop on non-finite loss (NanTensorHook parity; "
@@ -561,8 +565,22 @@ def main(argv: list[str] | None = None) -> int:
             # the step choice must agree across processes (broadcast from
             # process 0) exactly like restore_or_init — per-process
             # "latest" can diverge on a lagging shared filesystem
-            step = (args.eval_step if args.eval_step is not None
-                    else _agreed_latest_step(trainer.ckpt_manager))
+            if args.eval_best:
+                if args.eval_step is not None:
+                    raise SystemExit(
+                        "--eval_best and --eval_step are exclusive")
+                # broadcast like the latest-step path: per-process reads
+                # of the state file can diverge on a lagging shared fs
+                from ..ckpt.checkpoint import _agreed_best_step
+                step = _agreed_best_step(trainer.ckpt_manager)
+                if step is None:
+                    raise SystemExit(
+                        "--eval_best: no best checkpoint recorded under "
+                        f"{args.ckpt_dir!r} (train with "
+                        "--keep_best_metric first)")
+            else:
+                step = (args.eval_step if args.eval_step is not None
+                        else _agreed_latest_step(trainer.ckpt_manager))
             if step is None:
                 raise SystemExit(
                     f"--eval_only: no checkpoint under {args.ckpt_dir!r}")

@@ -103,12 +103,16 @@ class Trainer:
         self.hooks = self._default_hooks() + list(hooks or [])
         self._eval_fn = None
 
-        if (config.checkpoint.keep_best_metric
-                and self.eval_arrays is None):
-            # fail fast: best tracking without an eval split would be a
-            # silent no-op (both save_best call sites are eval-gated)
+        if config.checkpoint.keep_best_metric and (
+                self.eval_arrays is None or self.ckpt_manager is None):
+            # fail fast: best tracking without an eval split OR without
+            # a checkpoint directory would be a silent no-op (both
+            # save_best call sites are eval-gated and manager-gated)
             raise ValueError(
-                "keep_best_metric needs eval data (none was provided)")
+                "keep_best_metric needs eval data and a checkpoint "
+                "directory (missing: "
+                + ("eval data" if self.eval_arrays is None
+                   else "checkpoint.directory") + ")")
 
         k = config.steps_per_loop
         if k > 1:

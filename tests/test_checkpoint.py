@@ -250,3 +250,52 @@ def test_save_best_rejects_nan(tmp_path):
     assert mgr.best_step() is None
     assert mgr.save_best(_mini_state(2, 2.0), 2, 0.7) is True
     assert mgr.best_step() == 2
+
+
+def test_cli_eval_best(tmp_path):
+    """--eval_only --eval_best evaluates the tracked best step."""
+    import json as _json
+
+    from distributed_tensorflow_example_tpu.cli.train import main
+    ck = str(tmp_path / "ck")
+    rc = main(["--model", "mlp", "--train_steps", "20", "--batch_size",
+               "64", "--eval_every_steps", "10", "--ckpt_dir", ck,
+               "--keep_best_metric", "accuracy"])
+    assert rc == 0
+    best = CheckpointManager(ck).best_step()
+    assert best is not None
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["--model", "mlp", "--eval_only", "--eval_best",
+                   "--ckpt_dir", ck, "--batch_size", "64"])
+    assert rc == 0
+    out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["step"] == best
+    with pytest.raises(SystemExit, match="exclusive"):
+        main(["--model", "mlp", "--eval_only", "--eval_best",
+              "--eval_step", "3", "--ckpt_dir", ck])
+
+
+def test_keep_best_without_ckpt_dir_fails_fast(tmp_path):
+    from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                           DataConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.data.mnist import (
+        synthetic_mnist)
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    data = synthetic_mnist(128, 64)
+    cfg = TrainConfig(model="mlp", train_steps=1,
+                      data=DataConfig(batch_size=64),
+                      checkpoint=CheckpointConfig(
+                          keep_best_metric="accuracy"))   # no directory
+    with pytest.raises(ValueError, match="checkpoint.directory"):
+        Trainer(get_model("mlp", cfg), cfg,
+                {"x": data["train_x"], "y": data["train_y"]},
+                eval_arrays={"x": data["test_x"], "y": data["test_y"]},
+                mesh=local_mesh(1, {"data": 1}),
+                process_index=0, num_processes=1)
