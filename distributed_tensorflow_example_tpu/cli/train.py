@@ -94,10 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="linear LR warmup steps")
     p.add_argument("--decay_schedule", default="constant",
                    choices=["constant", "cosine", "linear", "piecewise",
-                            "exponential", "polynomial"])
+                            "exponential", "polynomial", "natural_exp",
+                            "inverse_time"])
     p.add_argument("--decay_steps", type=int, default=0,
-                   help="exponential: steps per decay_factor application "
-                        "(tf.train.exponential_decay parity); polynomial: "
+                   help="exponential/natural_exp/inverse_time: steps per "
+                        "decay_factor application (tf.train decay-family "
+                        "parity; required for those three); polynomial: "
                         "absolute step where decay bottoms out (falls "
                         "back to --train_steps)")
     p.add_argument("--end_learning_rate", type=float, default=0.0,
@@ -126,6 +128,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "recipe uses 0.1)")
     p.add_argument("--grad_clip_norm", type=float, default=0.0,
                    help="global-norm gradient clipping (0 disables)")
+    p.add_argument("--grad_clip_value", type=float, default=0.0,
+                   help="elementwise |g| clipping (tf.clip_by_value "
+                        "parity; 0 disables; composes with the norm "
+                        "clip)")
     p.add_argument("--export_dir", default=None,
                    help="write a serving artifact (StableHLO via "
                         "jax.export, params baked in, batch-polymorphic) "
@@ -305,6 +311,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                                   end_learning_rate=args.end_learning_rate,
                                   decay_power=args.decay_power,
                                   grad_clip_norm=args.grad_clip_norm,
+                                  grad_clip_value=args.grad_clip_value,
                                   moment_dtype=args.moment_dtype,
                                   ema_decay=args.ema_decay,
                                   ema_debias=args.ema_debias,

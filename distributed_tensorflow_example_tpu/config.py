@@ -70,7 +70,9 @@ class OptimizerConfig:
                                     # recorded artifact used nonzero wd)
     warmup_steps: int = 0
     decay_schedule: str = "constant"  # constant | cosine | linear |
-                                      # piecewise | exponential | polynomial
+                                      # piecewise | exponential |
+                                      # polynomial | natural_exp |
+                                      # inverse_time (tf.train family)
     decay_boundaries: tuple[int, ...] = ()  # piecewise: steps where LR drops
     decay_factor: float = 0.1       # piecewise: multiplier at each boundary;
                                     # exponential: decay rate per decay_steps
@@ -87,6 +89,9 @@ class OptimizerConfig:
                                     # 1.0 = the linear BERT recipe)
     total_steps: int = 0            # for schedules; 0 => constant
     grad_clip_norm: float = 0.0     # 0 disables
+    grad_clip_value: float = 0.0    # elementwise |g| clip
+                                    # (tf.clip_by_value; 0 disables;
+                                    # composes with the norm clip)
     moment_dtype: str = "float32"   # float32 | bfloat16 — first-moment
                                     # (mu / momentum buffer) storage dtype;
                                     # bf16 halves that HBM traffic slice

@@ -17,6 +17,7 @@ is why this knob never touches it.
 
 from __future__ import annotations
 
+import math
 from typing import Any, NamedTuple
 
 import jax
@@ -94,6 +95,29 @@ def make_schedule(cfg: OptimizerConfig):
                 return _poly(count + _w)
         else:
             sched = poly
+    elif cfg.decay_schedule == "natural_exp":
+        # tf.train.natural_exp_decay parity: lr * exp(-rate * t / steps)
+        # == exponential decay with rate e^-decay_factor — reuse the
+        # exponential branch's builtin + warmup pre-application
+        if cfg.decay_steps <= 0:
+            raise ValueError(
+                "decay_schedule='natural_exp' needs decay_steps > 0")
+        k = cfg.decay_factor / cfg.decay_steps
+        sched = optax.exponential_decay(
+            base * math.exp(-k * cfg.warmup_steps),
+            transition_steps=cfg.decay_steps,
+            decay_rate=math.exp(-cfg.decay_factor))
+    elif cfg.decay_schedule == "inverse_time":
+        # tf.train.inverse_time_decay parity: lr / (1 + rate * t / steps)
+        # at ABSOLUTE step t (shift the joined count back past warmup)
+        if cfg.decay_steps <= 0:
+            raise ValueError(
+                "decay_schedule='inverse_time' needs decay_steps > 0")
+        k = cfg.decay_factor / cfg.decay_steps
+        w = cfg.warmup_steps
+
+        def sched(count, _k=k, _w=w):
+            return base / (1.0 + _k * (count + _w))
     elif cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
         sched = optax.constant_schedule(base)
     elif cfg.decay_schedule == "cosine":
@@ -215,6 +239,11 @@ def make_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
     parts: list[optax.GradientTransformation] = []
     if cfg.grad_clip_norm > 0:
         parts.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.grad_clip_value > 0:
+        # tf.clip_by_value on gradients (the era's elementwise clip);
+        # composes with the global-norm clip (applied after it, like
+        # chaining the two tf ops)
+        parts.append(optax.clip(cfg.grad_clip_value))
     name = cfg.name.lower()
     mdt = _moment_dtype(cfg)
     mask = _wd_mask(cfg)

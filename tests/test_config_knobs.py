@@ -482,3 +482,53 @@ def test_adafactor_composes_with_tensor_parallel_rules():
     state = sync.init(m.init)
     state, metrics = sync.step(state, sync.shard_batch(m.dummy_batch(8)))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_natural_exp_and_inverse_time_schedules():
+    """tf.train.natural_exp_decay / inverse_time_decay parity at
+    absolute steps, continuous (staircase off)."""
+    import math as _math
+
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    ne = make_schedule(OptimizerConfig(
+        learning_rate=0.8, decay_schedule="natural_exp",
+        decay_steps=100, decay_factor=0.5))
+    assert float(ne(0)) == pytest.approx(0.8)
+    assert float(ne(100)) == pytest.approx(0.8 * _math.exp(-0.5),
+                                           rel=1e-5)
+    assert float(ne(200)) == pytest.approx(0.8 * _math.exp(-1.0),
+                                           rel=1e-5)
+    # absolute-step contract under warmup
+    ne_w = make_schedule(OptimizerConfig(
+        learning_rate=0.8, decay_schedule="natural_exp",
+        decay_steps=100, decay_factor=0.5, warmup_steps=100))
+    assert float(ne_w(200)) == pytest.approx(0.8 * _math.exp(-1.0),
+                                             rel=1e-5)
+
+    it = make_schedule(OptimizerConfig(
+        learning_rate=0.8, decay_schedule="inverse_time",
+        decay_steps=100, decay_factor=0.5))
+    assert float(it(0)) == pytest.approx(0.8)
+    assert float(it(100)) == pytest.approx(0.8 / 1.5, rel=1e-5)
+    assert float(it(400)) == pytest.approx(0.8 / 3.0, rel=1e-5)
+    it_w = make_schedule(OptimizerConfig(
+        learning_rate=0.8, decay_schedule="inverse_time",
+        decay_steps=100, decay_factor=0.5, warmup_steps=100))
+    assert float(it_w(400)) == pytest.approx(0.8 / 3.0, rel=1e-5)
+    for name in ("natural_exp", "inverse_time"):
+        with pytest.raises(ValueError, match="decay_steps"):
+            make_schedule(OptimizerConfig(decay_schedule=name))
+
+
+def test_grad_clip_value():
+    """tf.clip_by_value on gradients: elements exceed the bound, the
+    update magnitude is capped per element (sgd lr=1 isolates it)."""
+    import optax
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=1.0,
+                                        grad_clip_value=0.5))
+    params = {"w": jnp.zeros((3,))}
+    grads = {"w": jnp.asarray([0.2, -3.0, 10.0])}
+    updates, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               [-0.2, 0.5, -0.5], rtol=1e-6)
