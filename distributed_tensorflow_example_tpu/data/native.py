@@ -183,7 +183,15 @@ def crc32c(data: bytes) -> int:
 def tfrecord_index(path: str, *, verify: bool = False
                    ) -> tuple[np.ndarray, np.ndarray]:
     """(data_offsets, data_lengths) int64 arrays for a TFRecord file,
-    scanned in C++ (verify additionally checks both per-record CRCs)."""
+    scanned in C++ (verify additionally checks both per-record CRCs).
+    GZIP shards are rejected here — the scanner would misparse
+    compressed bytes into garbage offsets."""
+    from .tfrecord import is_gzipped
+    if is_gzipped(path):
+        raise ValueError(
+            f"{path} is GZIP-compressed: offset indexing needs raw "
+            "byte offsets; decompress the shard or use "
+            "tfrecord_iterator (sequential)")
     lib = _load()
     if lib is None:
         raise RuntimeError("native loader unavailable")

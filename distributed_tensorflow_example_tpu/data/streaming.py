@@ -292,9 +292,9 @@ def _shard_index(path: str):
     indexing cost scales with record count, not dataset bytes."""
     from . import native
     if native.available():
-        return native.tfrecord_index(path)
+        return native.tfrecord_index(path)    # gzip-rejecting
     from .tfrecord import index_record_offsets
-    return index_record_offsets(path)
+    return index_record_offsets(path)         # gzip-rejecting
 
 
 class StreamingSource:

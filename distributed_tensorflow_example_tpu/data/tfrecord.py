@@ -111,41 +111,78 @@ class TFRecordWriter:
         self.close()
 
 
+def is_gzipped(path: str) -> bool:
+    """True when the file starts with the gzip magic + deflate method
+    byte (tfds/beam pipelines often ship GZIP-compressed TFRecord
+    shards). Three bytes, not two: a raw TFRecord whose first record
+    length happens to start 0x1f 0x8b must not be misclassified."""
+    with open(path, "rb") as f:
+        return f.read(3) == b"\x1f\x8b\x08"
+
+
 def tfrecord_iterator(path: str, *, verify: bool = False
                       ) -> Iterator[bytes]:
     """Stream records from a TFRecord file
     (``tf.compat.v1.io.tf_record_iterator`` parity). ``verify`` checks
-    both per-record CRCs and raises ValueError on corruption."""
+    both per-record CRCs and raises ValueError on corruption.
+    GZIP-compressed files (TFRecordOptions GZIP) are detected by magic
+    and streamed through decompression (sequential access only — the
+    random-access/offset paths reject gzip with a clear error)."""
+    if is_gzipped(path):
+        import gzip
+        try:
+            with gzip.open(path, "rb") as f:
+                yield from _iter_stream(f, path, verify, size=None)
+        except (EOFError, gzip.BadGzipFile, OSError) as e:
+            # one corruption contract for both paths: ValueError
+            raise ValueError(f"{path}: corrupt gzip stream ({e})") from e
+        return
     size = os.path.getsize(path)
     with open(path, "rb") as f:
-        pos = 0
-        while True:
-            header = f.read(12)
-            if not header:
-                return
-            if len(header) != 12:
-                raise ValueError(f"{path}: truncated record header")
-            pos += 12
-            (length,) = struct.unpack("<Q", header[:8])
-            # bound-check before read(): a corrupt huge length must be a
-            # clean ValueError, not an attempted 2^64-byte allocation
+        yield from _iter_stream(f, path, verify, size=size)
+
+
+#: single-record sanity bound for streams with unknowable total size
+_SANITY_CAP = 1 << 40
+
+
+def _iter_stream(f, path: str, verify: bool,
+                 size: "int | None") -> Iterator[bytes]:
+    """Record framing over a readable stream. ``size`` (plain files)
+    enables the huge-length bound check BEFORE read() — a corrupt
+    length must be a clean ValueError, not an attempted 2^64-byte
+    allocation; compressed streams have no cheap size, so reads are
+    capped at a sanity bound instead."""
+    pos = 0
+    while True:
+        header = f.read(12)
+        if not header:
+            return
+        if len(header) != 12:
+            raise ValueError(f"{path}: truncated record header")
+        pos += 12
+        (length,) = struct.unpack("<Q", header[:8])
+        if size is not None:
             remaining = size - pos
             if remaining < 4 or length > remaining - 4:
                 raise ValueError(f"{path}: truncated record data")
-            if verify:
-                (want,) = struct.unpack("<I", header[8:12])
-                if masked_crc32c(header[:8]) != want:
-                    raise ValueError(f"{path}: corrupt length crc")
-            data = f.read(length)
-            footer = f.read(4)
-            if len(data) != length or len(footer) != 4:
-                raise ValueError(f"{path}: truncated record data")
-            pos += length + 4
-            if verify:
-                (want,) = struct.unpack("<I", footer)
-                if masked_crc32c(data) != want:
-                    raise ValueError(f"{path}: corrupt data crc")
-            yield data
+        elif length > _SANITY_CAP:
+            raise ValueError(f"{path}: implausible record length "
+                             f"{length} (corrupt stream?)")
+        if verify:
+            (want,) = struct.unpack("<I", header[8:12])
+            if masked_crc32c(header[:8]) != want:
+                raise ValueError(f"{path}: corrupt length crc")
+        data = f.read(length)
+        footer = f.read(4)
+        if len(data) != length or len(footer) != 4:
+            raise ValueError(f"{path}: truncated record data")
+        pos += length + 4
+        if verify:
+            (want,) = struct.unpack("<I", footer)
+            if masked_crc32c(data) != want:
+                raise ValueError(f"{path}: corrupt data crc")
+        yield data
 
 
 class TFRecordFile:
@@ -162,15 +199,12 @@ class TFRecordFile:
             self._offsets, self._lengths = native.tfrecord_index(
                 path, verify=verify)
         else:
-            offs: list[int] = []
-            lens: list[int] = []
-            pos = 0
-            for rec in tfrecord_iterator(path, verify=verify):
-                offs.append(pos + 12)
-                lens.append(len(rec))
-                pos += 12 + len(rec) + 4
-            self._offsets = np.asarray(offs, np.int64)
-            self._lengths = np.asarray(lens, np.int64)
+            # the seek-based header scan (gzip-rejecting: random access
+            # needs raw byte offsets)
+            self._offsets, self._lengths = index_record_offsets(path)
+            if verify:
+                for _ in tfrecord_iterator(path, verify=True):
+                    pass
         self._f = open(path, "rb")
 
     def __len__(self) -> int:
@@ -454,6 +488,10 @@ def index_record_offsets(path: str) -> "tuple[np.ndarray, np.ndarray]":
     only — seeks past payloads, so indexing cost scales with record
     COUNT, not dataset bytes (the C++ scanner in data/native.py does the
     same off the GIL; this is the pure-Python fallback)."""
+    if is_gzipped(path):
+        raise ValueError(
+            f"{path} is GZIP-compressed: offset indexing needs byte "
+            "offsets; decompress the shard or use tfrecord_iterator")
     size = os.path.getsize(path)
     offs: list[int] = []
     lens: list[int] = []

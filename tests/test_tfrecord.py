@@ -228,3 +228,72 @@ def test_load_token_records_validates(tmp_path):
     write_examples(str(tmp_path / "b.tfrecord"), [{"other": [1, 2]}])
     with pytest.raises(ValueError, match="input_ids"):
         load_token_records([str(tmp_path / "b.tfrecord")])
+
+
+# -- GZIP-compressed shards ------------------------------------------------
+
+def test_gzip_tfrecords_stream(tmp_path, tf):
+    """tfds/beam-style GZIP shards stream through decompression; the TF
+    writer with GZIP options is the oracle source."""
+    path = str(tmp_path / "z.tfrecord")
+    opts = tf.io.TFRecordOptions(compression_type="GZIP")
+    with tf.io.TFRecordWriter(path, opts) as w:
+        for i in range(5):
+            w.write(encode_example({"input_ids":
+                                    np.arange(i, i + 3, dtype=np.int64)}))
+    from distributed_tensorflow_example_tpu.data.tfrecord import is_gzipped
+    assert is_gzipped(path)
+    recs = list(tfrecord_iterator(path, verify=True))
+    assert len(recs) == 5
+    np.testing.assert_array_equal(decode_example(recs[2])["input_ids"],
+                                  [2, 3, 4])
+    # ...and the BERT token loader consumes them (sequential path)
+    rows = load_token_records([path])
+    assert rows.shape == (5, 3)
+
+
+def test_gzip_random_access_rejected(tmp_path):
+    import gzip
+    raw_path = str(tmp_path / "r.tfrecord")
+    with TFRecordWriter(raw_path) as w:
+        w.write(b"abc")
+    gz_path = str(tmp_path / "g.tfrecord")
+    with open(raw_path, "rb") as src, gzip.open(gz_path, "wb") as dst:
+        dst.write(src.read())
+    with pytest.raises(ValueError, match="GZIP"):
+        TFRecordFile(gz_path)
+    from distributed_tensorflow_example_tpu.data.tfrecord import (
+        index_record_offsets)
+    with pytest.raises(ValueError, match="GZIP"):
+        index_record_offsets(gz_path)
+
+
+def test_gzip_truncation_is_valueerror(tmp_path):
+    """Corrupt/truncated gzip must keep the ValueError corruption
+    contract, not leak EOFError/BadGzipFile."""
+    import gzip
+    raw = str(tmp_path / "a.tfrecord")
+    with TFRecordWriter(raw) as w:
+        w.write(b"payload" * 500)
+    gz = str(tmp_path / "z.tfrecord")
+    with open(raw, "rb") as s, gzip.open(gz, "wb") as d:
+        d.write(s.read())
+    blob = open(gz, "rb").read()
+    open(gz, "wb").write(blob[:len(blob) // 2])      # truncate mid-stream
+    with pytest.raises(ValueError, match="gzip"):
+        list(tfrecord_iterator(gz))
+
+
+def test_raw_record_with_gzip_like_length_not_misdetected(tmp_path):
+    """A raw TFRecord whose first record is exactly 0x081f8b + ... long
+    starts with bytes 0x1f 0x8b — the 3-byte magic check must still
+    treat it as raw."""
+    from distributed_tensorflow_example_tpu.data.tfrecord import is_gzipped
+    path = str(tmp_path / "r.tfrecord")
+    with TFRecordWriter(path) as w:
+        w.write(b"q" * 0x8B1F)     # length LE bytes: 1f 8b 00 ...
+    assert open(path, "rb").read(2) == b"\x1f\x8b"
+    assert not is_gzipped(path)
+    assert len(list(tfrecord_iterator(path, verify=True))) == 1
+    with TFRecordFile(path) as f:
+        assert len(f) == 1
