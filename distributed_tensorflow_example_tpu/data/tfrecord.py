@@ -130,11 +130,14 @@ def tfrecord_iterator(path: str, *, verify: bool = False
     random-access/offset paths reject gzip with a clear error)."""
     if is_gzipped(path):
         import gzip
+        import zlib
         try:
             with gzip.open(path, "rb") as f:
                 yield from _iter_stream(f, path, verify, size=None)
-        except (EOFError, gzip.BadGzipFile, OSError) as e:
-            # one corruption contract for both paths: ValueError
+        except (EOFError, gzip.BadGzipFile, zlib.error) as e:
+            # one CORRUPTION contract for both paths: ValueError.
+            # (No broad OSError here: a transient I/O failure must not
+            # be rebranded as data corruption)
             raise ValueError(f"{path}: corrupt gzip stream ({e})") from e
         return
     size = os.path.getsize(path)

@@ -47,20 +47,26 @@ def cast_floating(tree, dtype):
     return jax.tree_util.tree_map(c, tree)
 
 
-def classification_eval_metrics(logits, batch) -> dict:
+def classification_eval_metrics(logits, batch, *, top5: bool = False
+                                ) -> dict:
     """Shared eval_metrics body for integer-label classifiers.
 
     Honors an optional ``batch["__valid__"]`` example mask (1.0 = real
     example, 0.0 = padding) so the Trainer can pad the eval tail batch to a
     static shape — one compiled executable for the whole eval pass instead
     of a recompile per distinct tail size (SURVEY.md §2.3 static-shape
-    discipline)."""
+    discipline). ``top5`` adds the ImageNet recipes' second headline
+    number."""
     from ..ops import losses
     w = batch.get("__valid__")
-    return {
+    out = {
         "loss": losses.softmax_xent_int_labels(logits, batch["y"], where=w),
         "accuracy": losses.accuracy(logits, batch["y"], where=w),
     }
+    if top5:
+        out["top5_accuracy"] = losses.topk_accuracy(
+            logits, batch["y"], 5, where=w)
+    return out
 
 
 class Model(Protocol):

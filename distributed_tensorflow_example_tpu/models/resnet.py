@@ -205,7 +205,10 @@ class ResNet(DefaultRulesMixin):
 
     def eval_metrics(self, params, extras, batch) -> dict:
         logits, _ = self.apply(params, extras, batch, train=False)
-        return classification_eval_metrics(logits, batch)
+        # top-5 is the ImageNet recipes' second headline number; only
+        # meaningful when there are >5 classes (resnet50's 1000)
+        return classification_eval_metrics(
+            logits, batch, top5=self.num_classes > 5)
 
     def dummy_batch(self, batch_size: int):
         rs = np.random.RandomState(0)

@@ -13,14 +13,21 @@ import jax
 import jax.numpy as jnp
 
 
+def _masked_mean(values: jax.Array, where) -> jax.Array:
+    """Mean over examples, restricted by optional example weights
+    ``where`` (the padded static-shape eval tail's mask) — the ONE
+    masked-mean definition every metric/loss in this module shares."""
+    if where is None:
+        return jnp.mean(values)
+    return jnp.sum(values * where) / jnp.maximum(jnp.sum(where), 1.0)
+
+
 def softmax_xent(logits: jax.Array, onehot: jax.Array,
                  *, where=None) -> jax.Array:
     """Mean softmax cross-entropy against one-hot (or soft) targets."""
     logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
     ll = jnp.sum(onehot * (logits - logz), axis=-1)
-    if where is not None:
-        return -jnp.sum(ll * where) / jnp.maximum(jnp.sum(where), 1.0)
-    return -jnp.mean(ll)
+    return -_masked_mean(ll, where)
 
 
 def softmax_xent_int_labels(logits: jax.Array, labels: jax.Array,
@@ -44,9 +51,7 @@ def softmax_xent_int_labels(logits: jax.Array, labels: jax.Array,
         eps = label_smoothing
         picked = (1.0 - eps) * picked + eps * jnp.mean(logits, axis=-1)
     ll = picked - logz
-    if where is not None:
-        return -jnp.sum(ll * where) / jnp.maximum(jnp.sum(where), 1.0)
-    return -jnp.mean(ll)
+    return -_masked_mean(ll, where)
 
 
 def sigmoid_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -66,6 +71,18 @@ def accuracy(logits: jax.Array, labels: jax.Array,
     ``where`` (example weights) restricts the mean — used by the padded
     static-shape eval tail."""
     hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
-    if where is not None:
-        return jnp.sum(hit * where) / jnp.maximum(jnp.sum(where), 1.0)
-    return jnp.mean(hit)
+    return _masked_mean(hit, where)
+
+
+def topk_accuracy(logits: jax.Array, labels: jax.Array, k: int,
+                  *, where=None) -> jax.Array:
+    """Top-k accuracy (in_top_k parity — the ImageNet recipes' second
+    headline number). Counts a hit when the true class's logit ranks in
+    the top k; ties resolve by logit comparison against the true
+    class's logit, matching tf.nn.in_top_k semantics closely enough for
+    distinct-logit models."""
+    true_logit = jnp.take_along_axis(logits, labels[..., None],
+                                     axis=-1)
+    rank = jnp.sum((logits > true_logit).astype(jnp.int32), axis=-1)
+    hit = (rank < k).astype(jnp.float32)
+    return _masked_mean(hit, where)

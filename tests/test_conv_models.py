@@ -112,3 +112,41 @@ def test_lenet_learns(cpu8):
         state, metr = sync.step(state, b)
         losses.append(float(metr["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_topk_accuracy_oracle():
+    """topk_accuracy vs a numpy argsort oracle, incl. the padded-tail
+    mask."""
+    from distributed_tensorflow_example_tpu.ops.losses import (
+        accuracy, topk_accuracy)
+    rs = np.random.RandomState(0)
+    logits = jnp.asarray(rs.randn(32, 10).astype(np.float32))
+    labels = jnp.asarray(rs.randint(0, 10, 32).astype(np.int32))
+    top5 = np.argsort(np.asarray(logits), axis=-1)[:, -5:]
+    want = np.mean([int(labels[i]) in top5[i] for i in range(32)])
+    got = float(topk_accuracy(logits, labels, 5))
+    assert got == pytest.approx(want)
+    # k=1 degenerates to plain accuracy
+    assert float(topk_accuracy(logits, labels, 1)) == pytest.approx(
+        float(accuracy(logits, labels)))
+    # masked: only the first 8 rows count
+    w = jnp.asarray(([1.0] * 8) + ([0.0] * 24))
+    want8 = np.mean([int(labels[i]) in top5[i] for i in range(8)])
+    assert float(topk_accuracy(logits, labels, 5, where=w)) == \
+        pytest.approx(want8)
+
+
+def test_resnet50_eval_reports_top5():
+    cfg = TrainConfig(model="resnet50")
+    m = get_model("resnet50", cfg)
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    metrics = jax.jit(m.eval_metrics)(params, extras, m.dummy_batch(4))
+    assert "top5_accuracy" in metrics
+    assert 0.0 <= float(metrics["top5_accuracy"]) <= 1.0
+    # cifar-scale resnet20 (10 classes) also reports it; mlp does not
+    m20 = get_model("resnet20", TrainConfig(model="resnet20"))
+    out = m20.init(jax.random.key(0))
+    p20, e20 = out if isinstance(out, tuple) else (out, {})
+    assert "top5_accuracy" in jax.jit(m20.eval_metrics)(
+        p20, e20, m20.dummy_batch(4))

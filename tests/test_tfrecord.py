@@ -297,3 +297,30 @@ def test_raw_record_with_gzip_like_length_not_misdetected(tmp_path):
     assert len(list(tfrecord_iterator(path, verify=True))) == 1
     with TFRecordFile(path) as f:
         assert len(f) == 1
+
+
+def test_gzip_body_corruption_is_valueerror(tmp_path):
+    """Flipped bytes in the deflate body raise zlib.error internally —
+    the iterator must still surface the ValueError corruption
+    contract."""
+    import gzip
+    raw = str(tmp_path / "a.tfrecord")
+    with TFRecordWriter(raw) as w:
+        for i in range(20):
+            w.write(bytes([i]) * 400)
+    gz = str(tmp_path / "z.tfrecord")
+    with open(raw, "rb") as s, gzip.open(gz, "wb") as d:
+        d.write(s.read())
+    blob = bytearray(open(gz, "rb").read())
+    hit = False
+    for pos in range(20, len(blob) - 12, 37):   # skip header+footer
+        corrupted = bytearray(blob)
+        corrupted[pos] ^= 0xFF
+        open(gz, "wb").write(bytes(corrupted))
+        try:
+            list(tfrecord_iterator(gz))
+        except ValueError:
+            hit = True        # contract held for a corrupting flip
+        # silently-absorbed flips (deflate redundancy) are fine; any
+        # OTHER exception type fails the test by propagating
+    assert hit, "no corruption position raised at all"
