@@ -1,0 +1,208 @@
+"""Minimal REST predict server over an exported servable.
+
+The reference era shipped trained models to TensorFlow Serving and
+queried ``POST /v1/models/<name>:predict`` with ``{"instances": [...]}``
+(the TF Serving REST API). This module provides that serving-runtime
+role for this framework's artifacts — stdlib ``http.server`` around a
+:class:`~.serving.ServableModel`, speaking the same request/response
+shape:
+
+    POST /v1/models/<name>:predict
+    {"instances": [{"x": [...]}, ...]}          # row format, or
+    {"inputs": {"x": [[...], ...]}}             # columnar format
+    -> {"predictions": [[...], ...]}
+
+    GET /v1/models/<name>                        # status probe
+    -> {"model_version_status": [{"state": "AVAILABLE", ...}]}
+
+Batch-polymorphic artifacts (the export default) serve any instance
+count. This is a correctness/parity server, not a production QPS story:
+one worker, synchronous execution — the compute path is the same jitted
+StableHLO the offline servable runs.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from .serving import ServableModel, load_servable
+
+
+class PredictServer:
+    """Serve one exported model directory over HTTP.
+
+    >>> srv = PredictServer(export_dir)        # name defaults to meta
+    >>> srv.start()                            # background thread
+    >>> ... POST http://localhost:{srv.port}/v1/models/<name>:predict
+    >>> srv.stop()
+    """
+
+    def __init__(self, export_dir: str, *, name: str | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.servable: ServableModel = load_servable(export_dir)
+        self.name = name or self.servable.meta.get("model", "model")
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- request plumbing ----------------------------------------------
+    def _feature_arrays(self, payload: dict) -> dict[str, np.ndarray]:
+        sig = self.servable.input_signature
+        if "instances" in payload:
+            rows = payload["instances"]
+            if not isinstance(rows, list) or not rows:
+                raise ValueError("'instances' must be a non-empty list")
+            if not isinstance(rows[0], dict):
+                if len(sig) != 1:
+                    raise ValueError(
+                        f"bare instances need a single-input model; "
+                        f"this one takes {sorted(sig)}")
+                only = next(iter(sig))
+                rows = [{only: r} for r in rows]
+            cols = {k: [r[k] for r in rows] for k in rows[0]}
+        elif "inputs" in payload:
+            cols = payload["inputs"]
+            if not isinstance(cols, dict):
+                if len(sig) != 1:
+                    raise ValueError(
+                        f"bare inputs need a single-input model; this "
+                        f"one takes {sorted(sig)}")
+                cols = {next(iter(sig)): cols}
+        else:
+            raise ValueError("request needs 'instances' or 'inputs'")
+        missing = set(sig) - set(cols)
+        if missing:
+            raise ValueError(f"missing model inputs {sorted(missing)} "
+                             f"(want {sorted(sig)})")
+        out = {}
+        for key, spec in sig.items():
+            arr = np.asarray(cols[key], dtype=np.dtype(spec["dtype"]))
+            want_tail = tuple(spec["shape"][1:])
+            if arr.shape[1:] != want_tail:
+                raise ValueError(
+                    f"input {key!r} has per-instance shape "
+                    f"{arr.shape[1:]}, model wants {want_tail}")
+            out[key] = arr
+        return out
+
+    def predict(self, payload: dict) -> dict:
+        feats = self._feature_arrays(payload)
+        logits = np.asarray(self.servable(feats))
+        return {"predictions": logits.tolist()}
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # a malformed Content-Length larger than the body would
+            # otherwise block rfile.read forever, pinning the handler
+            # thread for the client connection's lifetime
+            timeout = 30
+
+            def log_message(self, *a):      # quiet: tests/CLI own stdout
+                pass
+
+            def _send(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == f"/v1/models/{server.name}":
+                    self._send(200, {"model_version_status": [{
+                        "version": "1", "state": "AVAILABLE",
+                        "status": {"error_code": "OK",
+                                   "error_message": ""}}]})
+                else:
+                    self._send(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if self.path != f"/v1/models/{server.name}:predict":
+                    self._send(404, {"error": f"unknown path {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n > 1 << 30:
+                        self._send(413, {"error": "request too large"})
+                        return
+                    body = self.rfile.read(n)
+                    if len(body) != n:
+                        self._send(400, {"error": "truncated body"})
+                        return
+                    payload = json.loads(body or b"{}")
+                except (ValueError, TimeoutError, OSError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    feats = server._feature_arrays(payload)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})  # client's fault
+                    return
+                try:
+                    logits = np.asarray(server.servable(feats))
+                    self._send(200, {"predictions": logits.tolist()})
+                except Exception as e:                  # server's fault:
+                    # platform mismatch, runtime OOM, ... must be a 500,
+                    # not a dropped connection or a client-blaming 400
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        return Handler
+
+    # -- lifecycle ------------------------------------------------------
+    def serve(self) -> None:
+        """Blocking serve loop (the CLI path); Ctrl-C stops cleanly."""
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            self.stop()
+
+    def start(self) -> "PredictServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="predict-server",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "PredictServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def main(argv=None) -> int:
+    """``python -m distributed_tensorflow_example_tpu.serving_http
+    --export_dir D [--port P]`` — serve until interrupted."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--export_dir", required=True)
+    ap.add_argument("--name", default=None)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8501)
+    args = ap.parse_args(argv)
+    srv = PredictServer(args.export_dir, name=args.name, host=args.host,
+                        port=args.port)
+    print(f"serving {srv.name!r} on http://{args.host}:{srv.port}"
+          f"/v1/models/{srv.name}:predict", flush=True)
+    srv.serve()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

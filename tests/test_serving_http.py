@@ -1,0 +1,122 @@
+"""REST predict server (serving_http.py): the TF Serving API shape
+over an exported servable — row and columnar requests, status probe,
+input validation errors as 400s, and numerical agreement with the
+offline servable."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import TrainConfig
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.serving import (export_model,
+                                                        serving_signature)
+from distributed_tensorflow_example_tpu.serving_http import PredictServer
+
+
+@pytest.fixture(scope="module")
+def servable_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("servable"))
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    export_model(m, params, extras, d, platforms=("cpu",))
+    feats = serving_signature(m.dummy_batch(3))
+    want = np.asarray(m.apply(params, extras, feats, train=False)[0])
+    return d, feats, want
+
+
+def _post(port, name, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_predict_instances_and_inputs(servable_dir):
+    d, feats, want = servable_dir
+    with PredictServer(d) as srv:
+        x = np.asarray(feats["x"])
+        # row format
+        out = _post(srv.port, srv.name,
+                    {"instances": [{"x": row.tolist()} for row in x]})
+        np.testing.assert_allclose(np.asarray(out["predictions"]), want,
+                                   rtol=1e-5, atol=1e-5)
+        # columnar format
+        out2 = _post(srv.port, srv.name, {"inputs": {"x": x.tolist()}})
+        np.testing.assert_allclose(np.asarray(out2["predictions"]), want,
+                                   rtol=1e-5, atol=1e-5)
+        # bare rows work for single-input models
+        out3 = _post(srv.port, srv.name, {"instances": x.tolist()})
+        np.testing.assert_allclose(np.asarray(out3["predictions"]), want,
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_status_probe_and_unknown_paths(servable_dir):
+    d, _, _ = servable_dir
+    with PredictServer(d, name="mnist") as srv:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/models/mnist") as r:
+            st = json.loads(r.read())
+        assert st["model_version_status"][0]["state"] == "AVAILABLE"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/models/nope")
+        assert e.value.code == 404
+
+
+def test_bad_requests_are_400(servable_dir):
+    d, feats, _ = servable_dir
+    with PredictServer(d) as srv:
+        for payload in (
+                {},                                     # neither key
+                {"instances": []},                      # empty
+                {"instances": [{"y": [0.0]}]},          # wrong input name
+                {"inputs": {"x": [[0.0, 1.0]]}},        # wrong shape
+        ):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(srv.port, srv.name, payload)
+            assert e.value.code == 400
+            body = json.loads(e.value.read())
+            assert "error" in body
+
+
+def test_varying_batch_sizes_one_server(servable_dir):
+    """Batch polymorphism reaches the wire: any instance count on the
+    same running server."""
+    d, feats, _ = servable_dir
+    x = np.asarray(feats["x"])
+    with PredictServer(d) as srv:
+        for n in (1, 2, 3):
+            out = _post(srv.port, srv.name,
+                        {"inputs": {"x": x[:n].tolist()}})
+            assert np.asarray(out["predictions"]).shape == (n, 10)
+
+
+def test_server_fault_is_500_not_400(servable_dir):
+    """Runtime failures on the server side (platform mismatch, OOM) are
+    500s with a JSON error — never client-blaming 400s or dropped
+    connections."""
+    d, feats, _ = servable_dir
+    with PredictServer(d) as srv:
+        sig = srv.servable.input_signature
+
+        class Boom:
+            input_signature = sig
+            meta = {"model": "boom"}
+
+            def __call__(self, f):
+                raise RuntimeError("backend exploded")
+
+        srv.servable = Boom()
+        x = np.asarray(feats["x"])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name, {"inputs": {"x": x.tolist()}})
+        assert e.value.code == 500
+        assert "backend exploded" in json.loads(e.value.read())["error"]
