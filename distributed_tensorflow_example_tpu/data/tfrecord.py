@@ -289,8 +289,11 @@ def encode_example(features: dict[str, Any]) -> bytes:
         if isinstance(val, (bytes, str)):
             val = [val]
         arr = val if isinstance(val, (list, tuple)) else np.asarray(val)
-        if isinstance(arr, (list, tuple)) and arr and isinstance(
-                arr[0], (bytes, str)):
+        if isinstance(arr, (list, tuple)) and (
+                not arr or isinstance(arr[0], (bytes, str))):
+            # plain python lists are bytes lists — including EMPTY ones
+            # (an untyped [] cannot round-trip as a numeric list; typed
+            # empties arrive as numpy arrays and keep their kind)
             items = b"".join(
                 _ld(1, v.encode() if isinstance(v, str) else v)
                 for v in arr)

@@ -324,3 +324,56 @@ def test_gzip_body_corruption_is_valueerror(tmp_path):
         # silently-absorbed flips (deflate redundancy) are fine; any
         # OTHER exception type fails the test by propagating
     assert hit, "no corruption position raised at all"
+
+
+def test_example_codec_fuzz_against_tf(tmp_path, tf):
+    """Seeded property fuzz: random feature dicts (mixed types, sizes,
+    empty lists, negative/huge ints, unicode-ish bytes) must round-trip
+    through OUR encoder -> TF's parser and TF's encoder -> OUR parser
+    with identical values."""
+    rs = np.random.RandomState(1234)
+
+    def random_features(i):
+        feats = {}
+        for j in range(rs.randint(1, 5)):
+            key = f"k{i}_{j}_" + "".join(
+                rs.choice(list("abcxyz/_."), 3))
+            kind = rs.randint(0, 3)
+            n = int(rs.randint(0, 6))
+            if kind == 0:
+                feats[key] = rs.randint(-2 ** 62, 2 ** 62,
+                                        size=n).astype(np.int64)
+            elif kind == 1:
+                feats[key] = (rs.randn(n) * 10 ** rs.randint(-3, 4)
+                              ).astype(np.float32)
+            else:
+                feats[key] = [bytes(rs.randint(0, 256, rs.randint(0, 9),
+                                               ).astype(np.uint8))
+                              for _ in range(n)]
+        return feats
+
+    for i in range(40):
+        feats = random_features(i)
+        blob = encode_example(feats)
+        # direction 1: TF parses ours
+        e = tf.train.Example()
+        e.ParseFromString(blob)
+        for k, v in feats.items():
+            f = e.features.feature[k]
+            if isinstance(v, list):
+                assert list(f.bytes_list.value) == v, k
+            elif v.dtype == np.int64:
+                assert list(f.int64_list.value) == v.tolist(), k
+            else:
+                np.testing.assert_allclose(list(f.float_list.value), v,
+                                           rtol=1e-6, err_msg=k)
+        # direction 2: we parse TF's serialization of the same message
+        ours = decode_example(e.SerializeToString())
+        for k, v in feats.items():
+            if isinstance(v, list):
+                assert ours[k] == v, k
+            elif v.dtype == np.int64:
+                np.testing.assert_array_equal(ours[k], v, err_msg=k)
+            else:
+                np.testing.assert_allclose(ours[k], v, rtol=1e-6,
+                                           err_msg=k)
