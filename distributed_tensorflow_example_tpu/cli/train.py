@@ -219,6 +219,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write TensorBoard scalar event files here "
                         "(tf.summary FileWriter parity; no TF dependency)")
     p.add_argument("--eval_every_steps", type=int, default=0)
+    p.add_argument("--early_stop_metric", default=None,
+                   help="stop training when this eval metric stops "
+                        "improving (stop_if_no_decrease_hook parity; "
+                        "needs --eval_every_steps)")
+    p.add_argument("--early_stop_patience", type=int, default=3,
+                   help="evals without improvement before stopping")
+    p.add_argument("--early_stop_mode", default="max",
+                   choices=["max", "min"])
     p.add_argument("--eval_only", action="store_true",
                    help="no training: restore the latest checkpoint from "
                         "--ckpt_dir (or --eval_step N), run the eval "
@@ -279,6 +287,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         moe_top_k=args.moe_top_k,
         moe_capacity_factor=args.moe_capacity_factor,
         eval_every_steps=args.eval_every_steps,
+        early_stop_metric=args.early_stop_metric,
+        early_stop_patience=args.early_stop_patience,
+        early_stop_mode=args.early_stop_mode,
         steps_per_loop=args.steps_per_loop,
         seed=args.seed,
         dtype=args.dtype,

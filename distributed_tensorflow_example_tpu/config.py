@@ -222,6 +222,14 @@ class TrainConfig:
     moe_top_k: int | None = None         # routed experts per token
     moe_capacity_factor: float | None = None
     eval_every_steps: int = 0        # 0 => eval only at the end
+    early_stop_metric: str | None = None  # stop when this eval metric
+                                          # stops improving
+                                          # (stop_if_no_decrease_hook
+                                          # parity; needs
+                                          # eval_every_steps)
+    early_stop_patience: int = 3     # evals without improvement before
+                                     # stopping
+    early_stop_mode: str = "max"     # max (accuracy) | min (loss)
     steps_per_loop: int = 1          # steps per device dispatch (lax.scan
                                      # inner loop — TPU-era iterations_per_loop
                                      # semantics; hook cadences must divide)

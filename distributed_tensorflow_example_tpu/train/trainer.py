@@ -21,6 +21,9 @@ steps queue back-to-back on device with no host round-trip.
 from __future__ import annotations
 
 import dataclasses
+import json
+import math
+import os
 import time
 from typing import Any, Iterator
 
@@ -102,6 +105,21 @@ class Trainer:
         self.start_step = 0
         self.hooks = self._default_hooks() + list(hooks or [])
         self._eval_fn = None
+
+        if config.early_stop_metric:
+            if self.eval_arrays is None or not config.eval_every_steps:
+                raise ValueError(
+                    "early_stop_metric needs eval data AND "
+                    "eval_every_steps > 0 (improvement is judged at the "
+                    "eval cadence)")
+            if config.early_stop_mode not in ("max", "min"):
+                raise ValueError("early_stop_mode must be max|min, got "
+                                 f"{config.early_stop_mode!r}")
+            if config.early_stop_patience < 1:
+                raise ValueError("early_stop_patience must be >= 1")
+        self._early_best: float | None = None
+        self._early_misses = 0
+        self._last_eval: tuple[int, dict] | None = None
 
         if config.checkpoint.keep_best_metric and (
                 self.eval_arrays is None or self.ckpt_manager is None):
@@ -191,6 +209,8 @@ class Trainer:
         self.start_step = int(jax.device_get(state.step))
         if restored:
             log.info("restored checkpoint at step %d", self.start_step)
+            if self.config.early_stop_metric:
+                self._early_stop_load()   # patience survives preemption
         else:
             log.info("initialized fresh state: %d params",
                      param_count(state.params))
@@ -320,6 +340,9 @@ class Trainer:
                              {k: round(v, 4) for k, v in ev.items()})
                     self.metrics_logger.log({"step": step, "eval": ev})
                     self._maybe_save_best(state, step, ev)
+                    self._last_eval = (step, ev)
+                    if self._early_stop_hit(step, ev):
+                        stop = True
 
             # block on the final step so hook teardown sees settled state
             jax.block_until_ready(state.params)
@@ -354,9 +377,73 @@ class Trainer:
             summary["final_metrics"] = {
                 k: float(v) for k, v in jax.device_get(device_metrics).items()}
         if self.eval_arrays is not None:
-            summary["eval"] = self.evaluate(state)
-            self._maybe_save_best(state, step, summary["eval"])
+            if self._last_eval is not None and self._last_eval[0] == step:
+                # the loop just evaluated this exact step (early stop /
+                # cadence landing on the final step): don't pay a second
+                # full eval pass on unchanged params
+                summary["eval"] = self._last_eval[1]
+            else:
+                summary["eval"] = self.evaluate(state)
+                self._maybe_save_best(state, step, summary["eval"])
         return state, summary
+
+    # early-stop progress survives preemption in a sidecar next to the
+    # checkpoints (the counters are host-side floats, not state leaves)
+    def _early_stop_path(self) -> str | None:
+        d = self.config.checkpoint.directory
+        return os.path.join(d, "early_stop.json") if d else None
+
+    def _early_stop_save(self) -> None:
+        path = self._early_stop_path()
+        if path is None or jax.process_index() != 0:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"best": self._early_best,
+                       "misses": self._early_misses}, f)
+        os.replace(tmp, path)
+
+    def _early_stop_load(self) -> None:
+        path = self._early_stop_path()
+        if path is None or not os.path.exists(path):
+            return
+        with open(path) as f:
+            st = json.load(f)
+        self._early_best = st.get("best")
+        self._early_misses = int(st.get("misses", 0))
+        log.info("early-stop state restored: best=%s misses=%d",
+                 self._early_best, self._early_misses)
+
+    def _early_stop_hit(self, step: int, ev: dict) -> bool:
+        """stop_if_no_decrease_hook parity: True once the tracked eval
+        metric has gone ``early_stop_patience`` evals without improving.
+        NaN evals count as misses (they improve on nothing)."""
+        metric = self.config.early_stop_metric
+        if not metric:
+            return False
+        if metric not in ev:
+            raise ValueError(
+                f"early_stop_metric={metric!r} is not an eval metric "
+                f"(eval produced {sorted(ev)})")
+        value = float(ev[metric])
+        better = (not math.isnan(value)) and (
+            self._early_best is None
+            or (value > self._early_best
+                if self.config.early_stop_mode == "max"
+                else value < self._early_best))
+        if better:
+            self._early_best = value
+            self._early_misses = 0
+            self._early_stop_save()
+            return False
+        self._early_misses += 1
+        self._early_stop_save()
+        if self._early_misses >= self.config.early_stop_patience:
+            log.info("early stop at step %d: %s did not improve for %d "
+                     "evals (best %s)", step, metric,
+                     self._early_misses, self._early_best)
+            return True
+        return False
 
     def _maybe_save_best(self, state: TrainState, step: int,
                          ev: dict) -> None:

@@ -206,3 +206,89 @@ def test_learning_rate_logged_with_rates(tmp_path):
     assert lrs[2] == pytest.approx(0.375)
     assert lrs[4] == pytest.approx(0.125)
     assert tr.learning_rate_at(1) == pytest.approx(0.5)   # sched(0)
+
+
+def test_early_stopping_stops_and_validates(tmp_path):
+    """stop_if_no_decrease_hook parity: a metric that cannot improve
+    (accuracy already saturated at 1.0 on this easy set) trips the
+    patience and stops before train_steps; misconfigurations fail
+    fast."""
+    data = synthetic_mnist(512, 128)
+    arrays = {"x": data["train_x"], "y": data["train_y"]}
+    evals = {"x": data["test_x"], "y": data["test_y"]}
+    cfg = TrainConfig(model="mlp", train_steps=400, eval_every_steps=20,
+                      early_stop_metric="accuracy",
+                      early_stop_patience=2,
+                      data=DataConfig(batch_size=64),
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.5))
+    tr = Trainer(get_model("mlp", cfg), cfg, arrays, eval_arrays=evals,
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    state, summary = tr.train()
+    tr.close()
+    # accuracy saturates at 1.0 quickly; after 2 non-improving evals the
+    # loop stops long before 400
+    assert summary["final_step"] < 400, summary["final_step"]
+
+    with pytest.raises(ValueError, match="early_stop"):
+        Trainer(get_model("mlp", cfg), cfg.replace(eval_every_steps=0),
+                arrays, eval_arrays=evals,
+                mesh=local_mesh(1, {"data": 1}),
+                process_index=0, num_processes=1)
+    with pytest.raises(ValueError, match="early_stop"):
+        Trainer(get_model("mlp", cfg),
+                cfg.replace(early_stop_patience=0), arrays,
+                eval_arrays=evals, mesh=local_mesh(1, {"data": 1}),
+                process_index=0, num_processes=1)
+
+
+def test_early_stop_unknown_metric_raises(tmp_path):
+    data = synthetic_mnist(128, 64)
+    cfg = TrainConfig(model="mlp", train_steps=4, eval_every_steps=2,
+                      early_stop_metric="f1",
+                      data=DataConfig(batch_size=64))
+    tr = Trainer(get_model("mlp", cfg), cfg,
+                 {"x": data["train_x"], "y": data["train_y"]},
+                 eval_arrays={"x": data["test_x"], "y": data["test_y"]},
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    with pytest.raises(ValueError, match="early_stop_metric"):
+        tr.train()
+    tr.close()
+
+
+def test_early_stop_state_survives_resume(tmp_path):
+    """Preemption parity: the patience counter persists in a sidecar
+    next to the checkpoints, so a resumed run continues the window
+    instead of resetting it."""
+    data = synthetic_mnist(512, 128)
+    arrays = {"x": data["train_x"], "y": data["train_y"]}
+    evals = {"x": data["test_x"], "y": data["test_y"]}
+    from distributed_tensorflow_example_tpu.config import CheckpointConfig
+    cfg = TrainConfig(model="mlp", train_steps=60, eval_every_steps=20,
+                      early_stop_metric="accuracy",
+                      early_stop_patience=4,
+                      data=DataConfig(batch_size=64),
+                      optimizer=OptimizerConfig(name="sgd",
+                                                learning_rate=0.5),
+                      checkpoint=CheckpointConfig(
+                          directory=str(tmp_path / "ck"), save_steps=20))
+    tr = Trainer(get_model("mlp", cfg), cfg, arrays, eval_arrays=evals,
+                 mesh=local_mesh(1, {"data": 1}),
+                 process_index=0, num_processes=1)
+    tr.train()
+    misses1, best1 = tr._early_misses, tr._early_best
+    tr.close()
+    assert json.load(open(tmp_path / "ck" / "early_stop.json")) \
+        == {"best": best1, "misses": misses1}
+
+    # resume for more steps: the counters carry over
+    cfg2 = cfg.replace(train_steps=100)
+    tr2 = Trainer(get_model("mlp", cfg2), cfg2, arrays,
+                  eval_arrays=evals, mesh=local_mesh(1, {"data": 1}),
+                  process_index=0, num_processes=1)
+    tr2.initialize()
+    assert tr2._early_best == best1
+    assert tr2._early_misses == misses1
+    tr2.close()
