@@ -141,3 +141,20 @@ def test_exported_bert_takes_feature_keys_only(tmp_path):
     assert "masked_labels" not in meta["input_signature"]
     assert "masked_weights" not in meta["input_signature"]
     assert "input_ids" in meta["input_signature"]
+
+
+def test_export_bf16_params(tmp_path):
+    """bf16 param_dtype exports and serves (StableHLO serializes the
+    bf16 constants; logits still come out f32)."""
+    cfg = TrainConfig(model="mlp", param_dtype="bfloat16",
+                      dtype="bfloat16")
+    m = get_model("mlp", cfg)
+    params, extras = _init(m)
+    d = str(tmp_path / "bf16")
+    export_model(m, params, extras, d, platforms=("cpu",))
+    sv = load_servable(d)
+    feats = serving_signature(m.dummy_batch(4))
+    out = np.asarray(sv(feats))
+    assert out.dtype == np.float32
+    want = np.asarray(m.apply(params, extras, feats, train=False)[0])
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
