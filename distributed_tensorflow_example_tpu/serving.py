@@ -53,7 +53,11 @@ def export_model(model, params, extras, out_dir: str, *,
 
     ``platforms`` lowers one artifact for every listed backend (the
     default covers this sandbox's CPU tests and the TPU target).
-    ``batch_polymorphic`` exports the leading dimension symbolically.
+    ``batch_polymorphic`` exports the leading dimension symbolically;
+    models whose COMPUTATION depends concretely on the batch size (MoE:
+    expert capacity = f(token count)) cannot trace symbolically — they
+    fall back to a static-batch artifact automatically (recorded in the
+    metadata; the servable then accepts exactly ``batch_size``).
     """
     batch = sample_batch or model.dummy_batch(batch_size)
     features = serving_signature(batch)
@@ -70,16 +74,39 @@ def export_model(model, params, extras, out_dir: str, *,
         logits, _ = model.apply(params, extras, feats, train=False)
         return logits
 
+    def _export(poly: bool):
+        if poly:
+            specs = jax_export.symbolic_args_specs(
+                (features,), "b, ...")[0]
+        else:
+            specs = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                               jnp.asarray(x).dtype),
+                features)
+        return jax_export.export(
+            jax.jit(serve), platforms=list(platforms))(specs)
+
+    # symbolic-batch traces can fail several ways: concretization (MoE
+    # capacity math), inconclusive symbolic-dim comparisons, or plain
+    # TypeError from Python int ops on symbolic dims
+    _symbolic_failures = (jax.errors.ConcretizationTypeError, TypeError)
+    _idop = getattr(jax.core, "InconclusiveDimensionOperation", None)
+    if _idop is not None:
+        _symbolic_failures += (_idop,)
     if batch_polymorphic:
-        specs = jax_export.symbolic_args_specs(
-            (features,), "b, ...")[0]
+        try:
+            exported = _export(True)
+        except _symbolic_failures:
+            from .utils.logging import get_logger
+            get_logger("serving").warning(
+                "batch-polymorphic export impossible (computation "
+                "depends on the batch size); exporting static batch %d "
+                "— the servable accepts exactly that instance count",
+                jax.tree_util.tree_leaves(features)[0].shape[0])
+            batch_polymorphic = False
+            exported = _export(False)
     else:
-        specs = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(np.shape(x),
-                                           jnp.asarray(x).dtype),
-            features)
-    exported = jax_export.export(
-        jax.jit(serve), platforms=list(platforms))(specs)
+        exported = _export(False)
 
     artifact = os.path.join(out_dir, _ARTIFACT)
     if jax.process_index() != 0:

@@ -16,9 +16,11 @@ shape:
     -> {"model_version_status": [{"state": "AVAILABLE", ...}]}
 
 Batch-polymorphic artifacts (the export default) serve any instance
-count. This is a correctness/parity server, not a production QPS story:
-one worker, synchronous execution — the compute path is the same jitted
-StableHLO the offline servable runs.
+count; static-batch artifacts (the MoE fallback) accept exactly their
+exported instance count, and a mismatch is a 400. This is a
+correctness/parity server, not a production QPS story: one worker,
+synchronous execution — the compute path is the same jitted StableHLO
+the offline servable runs.
 """
 
 from __future__ import annotations
@@ -88,6 +90,14 @@ class PredictServer:
                 raise ValueError(
                     f"input {key!r} has per-instance shape "
                     f"{arr.shape[1:]}, model wants {want_tail}")
+            if (not self.servable.meta.get("batch_polymorphic", True)
+                    and arr.shape[0] != spec["shape"][0]):
+                # static-batch artifact (e.g. MoE fallback): a wrong
+                # instance count is the CLIENT's error, not an opaque
+                # XLA 500
+                raise ValueError(
+                    f"this artifact was exported with a static batch of "
+                    f"{spec['shape'][0]} instances; got {arr.shape[0]}")
             out[key] = arr
         return out
 

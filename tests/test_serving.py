@@ -25,13 +25,16 @@ def _init(model):
     return out if isinstance(out, tuple) else (out, {})
 
 
-@pytest.mark.parametrize("name", ["mlp", "lenet", "bert_tiny"])
+@pytest.mark.parametrize("name", ["mlp", "lenet", "bert_tiny",
+                                  "moe_bert_tiny",
+                                  "pipe_bert_tiny"])
 def test_export_roundtrip_matches_live_forward(name, tmp_path):
     cfg = TrainConfig(model=name)
     m = get_model(name, cfg)
     params, extras = _init(m)
     d = str(tmp_path / name)
-    artifact = export_model(m, params, extras, d, platforms=("cpu",))
+    artifact = export_model(m, params, extras, d, platforms=("cpu",),
+                            batch_size=4)
     assert os.path.exists(artifact)
 
     sv = load_servable(d)
@@ -158,3 +161,21 @@ def test_export_bf16_params(tmp_path):
     assert out.dtype == np.float32
     want = np.asarray(m.apply(params, extras, feats, train=False)[0])
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_moe_export_falls_back_to_static_batch(tmp_path):
+    """MoE capacity is a static function of the token count: the
+    symbolic-batch trace fails, the export falls back to a static
+    artifact (recorded in metadata) that serves exactly batch_size."""
+    m = get_model("moe_bert_tiny", TrainConfig(model="moe_bert_tiny"))
+    params, extras = _init(m)
+    d = str(tmp_path / "moe")
+    export_model(m, params, extras, d, platforms=("cpu",), batch_size=4)
+    meta = json.load(open(os.path.join(d, "export.json")))
+    assert meta["batch_polymorphic"] is False
+    sv = load_servable(d)
+    feats = serving_signature(m.dummy_batch(4))
+    np.testing.assert_allclose(
+        np.asarray(sv(feats)),
+        np.asarray(m.apply(params, extras, feats, train=False)[0]),
+        rtol=1e-5, atol=1e-5)

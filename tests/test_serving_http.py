@@ -120,3 +120,47 @@ def test_server_fault_is_500_not_400(servable_dir):
             _post(srv.port, srv.name, {"inputs": {"x": x.tolist()}})
         assert e.value.code == 500
         assert "backend exploded" in json.loads(e.value.read())["error"]
+
+
+def test_multi_input_model_over_rest(tmp_path):
+    """BERT-family servables take several feature keys per instance —
+    the row format zips them and the columnar format passes through."""
+    d = str(tmp_path / "bert")
+    m = get_model("bert_tiny", TrainConfig(model="bert_tiny"))
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    export_model(m, params, extras, d, platforms=("cpu",))
+    feats = serving_signature(m.dummy_batch(2))
+    want = np.asarray(m.apply(params, extras, feats, train=False)[0])
+    with PredictServer(d) as srv:
+        rows = [{k: np.asarray(v)[i].tolist() for k, v in feats.items()}
+                for i in range(2)]
+        out1 = _post(srv.port, srv.name, {"instances": rows})
+        np.testing.assert_allclose(np.asarray(out1["predictions"]), want,
+                                   rtol=1e-5, atol=1e-5)
+        # bare (non-dict) instances are invalid for multi-input models
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name,
+                  {"instances": [[1, 2, 3]]})
+        assert e.value.code == 400
+
+
+def test_static_artifact_wrong_batch_is_400(tmp_path):
+    """A static-batch servable (MoE fallback) rejects a mismatched
+    instance count as a clear 400, not an opaque XLA 500."""
+    d = str(tmp_path / "moe")
+    m = get_model("moe_bert_tiny", TrainConfig(model="moe_bert_tiny"))
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    export_model(m, params, extras, d, platforms=("cpu",), batch_size=4)
+    feats = serving_signature(m.dummy_batch(4))
+    with PredictServer(d) as srv:
+        ok = _post(srv.port, srv.name,
+                   {"inputs": {k: np.asarray(v).tolist()
+                               for k, v in feats.items()}})
+        assert len(ok["predictions"]) == 4
+        short = {k: np.asarray(v)[:2].tolist() for k, v in feats.items()}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name, {"inputs": short})
+        assert e.value.code == 400
+        assert "static batch" in json.loads(e.value.read())["error"]
