@@ -82,9 +82,10 @@ class OptimizerConfig:
                                     # polynomial: absolute step where the
                                     # decay bottoms out (falls back to
                                     # total_steps when 0)
-    end_learning_rate: float = 0.0  # polynomial: floor LR
+    end_learning_rate: float = 0.0  # polynomial AND cosine: floor LR
                                     # (tf.train.polynomial_decay
-                                    # 'end_learning_rate')
+                                    # 'end_learning_rate' /
+                                    # cosine_decay 'alpha' = end/base)
     decay_power: float = 1.0        # polynomial: exponent ('power';
                                     # 1.0 = the linear BERT recipe)
     total_steps: int = 0            # for schedules; 0 => constant

@@ -121,9 +121,18 @@ def make_schedule(cfg: OptimizerConfig):
     elif cfg.decay_schedule == "constant" or cfg.total_steps <= 0:
         sched = optax.constant_schedule(base)
     elif cfg.decay_schedule == "cosine":
-        sched = optax.cosine_decay_schedule(base, cfg.total_steps)
+        # tf.train.cosine_decay's `alpha` floor via end_learning_rate
+        # (absolute floor LR; alpha = end/base). Under warmup this is
+        # the standard ramp-then-cosine recipe: the decay spans
+        # end-of-warmup to ABSOLUTE step total_steps (same endpoint as
+        # the no-warmup tf schedule — not stretched past it)
+        sched = optax.cosine_decay_schedule(
+            base, max(1, cfg.total_steps - cfg.warmup_steps),
+            alpha=(cfg.end_learning_rate / base) if base else 0.0)
     elif cfg.decay_schedule == "linear":
-        sched = optax.linear_schedule(base, 0.0, cfg.total_steps)
+        # same absolute-endpoint convention as cosine
+        sched = optax.linear_schedule(
+            base, 0.0, max(1, cfg.total_steps - cfg.warmup_steps))
     else:
         raise ValueError(f"unknown decay_schedule {cfg.decay_schedule!r}")
     if cfg.warmup_steps > 0:

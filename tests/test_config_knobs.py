@@ -532,3 +532,43 @@ def test_grad_clip_value():
     updates, _ = tx.update(grads, tx.init(params), params)
     np.testing.assert_allclose(np.asarray(updates["w"]),
                                [-0.2, 0.5, -0.5], rtol=1e-6)
+
+
+def test_cosine_floor_via_end_learning_rate():
+    """tf.train.cosine_decay's alpha floor: the schedule decays to
+    end_learning_rate, not to zero, and holds there."""
+    import math as _math
+
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    sched = make_schedule(OptimizerConfig(
+        learning_rate=0.4, decay_schedule="cosine", total_steps=100,
+        end_learning_rate=0.04))
+    assert float(sched(0)) == pytest.approx(0.4)
+    # halfway: floor + (base-floor) * 0.5*(1+cos(pi/2)) = midpoint
+    mid = 0.04 + (0.4 - 0.04) * 0.5 * (1 + _math.cos(_math.pi / 2))
+    assert float(sched(50)) == pytest.approx(mid, rel=1e-5)
+    assert float(sched(100)) == pytest.approx(0.04, rel=1e-5)
+    assert float(sched(500)) == pytest.approx(0.04, rel=1e-5)
+    # default stays decay-to-zero
+    plain = make_schedule(OptimizerConfig(
+        learning_rate=0.4, decay_schedule="cosine", total_steps=100))
+    assert float(plain(100)) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_cosine_and_linear_end_at_absolute_total_steps():
+    """Under warmup, cosine/linear decays span end-of-warmup to the
+    ABSOLUTE total_steps endpoint (the standard ramp-then-decay recipe),
+    not total_steps + warmup."""
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_schedule)
+    cos = make_schedule(OptimizerConfig(
+        learning_rate=0.4, decay_schedule="cosine", total_steps=100,
+        warmup_steps=20, end_learning_rate=0.04))
+    assert float(cos(20)) == pytest.approx(0.4, rel=1e-5)   # peak
+    assert float(cos(100)) == pytest.approx(0.04, rel=1e-5)  # floor AT 100
+    lin = make_schedule(OptimizerConfig(
+        learning_rate=0.4, decay_schedule="linear", total_steps=100,
+        warmup_steps=20))
+    assert float(lin(100)) == pytest.approx(0.0, abs=1e-9)
+    assert float(lin(60)) == pytest.approx(0.2, rel=1e-5)   # midpoint
