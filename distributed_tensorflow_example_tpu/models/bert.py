@@ -290,6 +290,13 @@ class Bert:
         }
 
     # ------------------------------------------------------------------
+    #: TP rules for the (non-stacked) embedding/MLM head — shared with
+    #: PipeBert's PP×TP rules so the two sets cannot diverge.
+    TP_EMBED_RULES: tuple = (
+        (r"embed/word/table", P(AxisNames.MODEL, None)),   # vocab-sharded
+        (r"mlm/bias", P(AxisNames.MODEL)),
+    )
+
     def sharding_rules(self, mesh_shape) -> ShardingRules:
         """Megatron-style TP + vocab-sharded embeddings; fsdp fallback."""
         M = AxisNames.MODEL
@@ -304,8 +311,7 @@ class Bert:
             (r"ffn/in/kernel", P(None, M)),
             (r"ffn/in/bias", P(M)),
             (r"ffn/out/kernel", P(M, None)),
-            (r"embed/word/table", P(M, None)),    # vocab-sharded
-            (r"mlm/bias", P(M)),
+            *self.TP_EMBED_RULES,
         ], fsdp_axis_size=fsdp)
 
     def dummy_batch(self, batch_size: int):

@@ -117,12 +117,20 @@ def pipeline_spmd(stage_fn: StageFn, stage_params, microbatches,
 def make_pipeline(mesh: Mesh, stage_fn: StageFn, *,
                   num_microbatches: int,
                   pipe_axis: str = AxisNames.PIPE,
-                  batch_axes=AxisNames.BATCH):
+                  batch_axes=AxisNames.BATCH,
+                  param_specs=None, x_specs=None):
     """Bind a mesh → ``apply(stacked_params, x) -> y`` pipelined over pipe.
 
     ``stacked_params`` leaves have leading dim L (total blocks), sharded
     over ``pipe``; ``x`` is ``[B, ...]`` batch-sharded over the batch axes
     and replicated over pipe. Usable inside jit (shard_map composes).
+
+    ``param_specs`` / ``x_specs`` optionally override the per-leaf
+    ``PartitionSpec``s (pytrees matching ``stacked_params`` / ``x``) so the
+    pipeline composes with tensor parallelism: PipeBert passes param specs
+    whose kernel dims also carry the ``model`` axis and activation specs
+    seq-sharded over ``model`` (Megatron sequence-parallel layout). Every
+    param spec must keep ``pipe`` on the leading (stage) dim.
     """
     if num_microbatches < 1:
         raise ValueError(f"num_microbatches must be >= 1, got "
@@ -149,12 +157,14 @@ def make_pipeline(mesh: Mesh, stage_fn: StageFn, *,
                                 axis_name=pipe_axis)
             return _tmap(lambda a: a.reshape((b,) + a.shape[2:]), out)
 
-        params_specs = _tmap(lambda _: P(pipe_axis), stacked_params)
-        x_specs = _tmap(lambda _: P(batch_axes), x)
+        p_specs = (param_specs if param_specs is not None
+                   else _tmap(lambda _: P(pipe_axis), stacked_params))
+        a_specs = (x_specs if x_specs is not None
+                   else _tmap(lambda _: P(batch_axes), x))
         return jax.shard_map(
             body, mesh=mesh,
-            in_specs=(params_specs, x_specs),
-            out_specs=x_specs, check_vma=False)(stacked_params, x)
+            in_specs=(p_specs, a_specs),
+            out_specs=a_specs, check_vma=False)(stacked_params, x)
 
     return apply
 
