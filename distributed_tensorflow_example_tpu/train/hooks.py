@@ -259,12 +259,20 @@ class PreemptionHook(Hook):
     (SURVEY.md §3.4/§3.5) applied to the TPU world, where the signal is
     typically a VM maintenance-event notice.
 
-    Scope: per-process. On a single process this turns a SIGTERM into
-    "checkpoint at the step boundary and exit 0". Multi-host runs must be
-    stopped by the orchestrator on every host (a one-host stop would leave
-    the others blocked in a collective); there the recovery story is the
-    restore-or-init path on restart, not this hook — so the Trainer only
-    installs it when ``jax.process_count() == 1``.
+    Single process: a Python signal handler turns SIGTERM into
+    "checkpoint at the step boundary and exit 0".
+
+    Multi-process: a one-host Python-level stop would leave the other
+    hosts blocked in a collective, so the hook instead rides the TSL
+    coordination service's preemption protocol (the same C++ service the
+    reference's modern failure detection uses, SURVEY.md §5.3): the TSL
+    preemption notifier owns SIGTERM (installed by
+    ``jax.distributed.initialize``), the notice is broadcast through the
+    coordination service, and ``reached_preemption_sync_point(step)``
+    returns True on EVERY process at the SAME future step boundary — all
+    hosts stop together, all participate in the final (possibly
+    process_allgather-ing or sharded) checkpoint save, and all exit 0.
+    A SIGTERM to ANY ONE process therefore checkpoints the whole cluster.
     """
 
     def __init__(self, signals: tuple[int, ...] | None = None):
@@ -272,11 +280,20 @@ class PreemptionHook(Hook):
         self.signals = signals or (_signal.SIGTERM, _signal.SIGINT)
         self.stop_requested = False
         self._prev: dict[int, Any] = {}
+        self._multiprocess = False
+        self._last_polled: int | None = None
 
     def begin(self, trainer):
         import signal as _signal
         self.stop_requested = False   # a prior run's stop must not leak
                                       # into a resumed train() call
+        self._multiprocess = jax.process_count() > 1
+        self._last_polled = None
+        if self._multiprocess:
+            # SIGTERM belongs to the TSL preemption notifier here; a
+            # Python handler would steal the signal from the cross-host
+            # sync protocol (after_step polls the sync point instead)
+            return
 
         def handler(signum, frame):
             if self.stop_requested:
@@ -304,6 +321,24 @@ class PreemptionHook(Hook):
             self.end(trainer)
 
     def after_step(self, trainer, step, metrics):
+        if self._multiprocess and not self.stop_requested:
+            from jax.experimental import multihost_utils
+            # the sync protocol's contract is one call per TRAINING step
+            # with consecutive ids (the safe step is max reported + 1 and
+            # fires on equality) — under steps_per_loop > 1 the loop
+            # advances K at a time, so poll every id in the gap or the
+            # safe step could fall between observed boundaries and the
+            # stop would silently never fire
+            start = (int(step) if self._last_polled is None
+                     else self._last_polled + 1)
+            for s in range(start, int(step) + 1):
+                if multihost_utils.reached_preemption_sync_point(s):
+                    log.warning("preemption sync point at step %d: all "
+                                "processes stopping (checkpoint will be "
+                                "written)", step)
+                    self.stop_requested = True
+                    break
+            self._last_polled = int(step)
         return self.stop_requested or None
 
     def end(self, trainer):

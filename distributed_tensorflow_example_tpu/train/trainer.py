@@ -179,10 +179,10 @@ class Trainer:
             hs.append(hooks_lib.CheckpointSaverHook(
                 self.ckpt_manager, save_steps=cfg.checkpoint.save_steps,
                 save_secs=cfg.checkpoint.save_secs))
-            if self.num_processes == 1:
-                # SIGTERM → save-and-exit; multi-host stop is the
-                # orchestrator's job (see PreemptionHook docstring)
-                hs.append(hooks_lib.PreemptionHook())
+            # SIGTERM → save-and-exit; multi-host runs coordinate the
+            # stop step through the TSL preemption sync point (see
+            # PreemptionHook docstring)
+            hs.append(hooks_lib.PreemptionHook())
         if cfg.obs.profile_steps and cfg.obs.profile_dir:
             hs.append(hooks_lib.ProfilerHook(cfg.obs.profile_dir,
                                              *cfg.obs.profile_steps))

@@ -1,0 +1,47 @@
+"""Shared driver for two-process cluster tests (not a pytest module).
+
+One place for the subprocess harness that test_two_process_cluster,
+test_two_process_ep_pp, and test_two_process_preemption all need: boot
+two worker processes with a fresh coordinator port, wait with a timeout,
+kill the pair on a hang, and surface each worker's tail output on
+failure.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def run_two_process(worker_script: str, args=(), *, timeout: int = 600,
+                    port: int | None = None) -> None:
+    """Run ``worker_script <pid> <port> <args...>`` as processes 0 and 1;
+    assert both exit 0. XLA_FLAGS is cleared so workers set their own
+    per-process device count."""
+    port = port or free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen([sys.executable, worker_script, str(pid),
+                          str(port), *map(str, args)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"

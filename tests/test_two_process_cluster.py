@@ -20,47 +20,21 @@ Covered (all unreachable from process_count=1 tests):
 """
 
 import os
-import socket
-import subprocess
 import sys
 
 import numpy as np
 import pytest
 
+from _cluster_harness import run_two_process
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _WORKER = os.path.join(_DIR, "_two_process_worker.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
 
 
 @pytest.fixture(scope="module")
 def two_proc_result(tmp_path_factory):
     outdir = str(tmp_path_factory.mktemp("twoproc"))
-    port = _free_port()
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)       # worker sets its own 4-device flag
-    procs = [
-        subprocess.Popen([sys.executable, _WORKER, str(pid), str(port),
-                          outdir],
-                         env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, text=True)
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-4000:]}"
+    run_two_process(_WORKER, [outdir], timeout=300)
     return outdir
 
 
