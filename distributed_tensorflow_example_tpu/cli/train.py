@@ -122,6 +122,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="MoE models: per-expert slot headroom "
                         "C = ceil(T/E * factor); overflow tokens drop "
                         "to the residual path")
+    p.add_argument("--moe_every", type=int, default=None,
+                   help="MoE models: MoE FFN every k-th layer "
+                        "(default: the model's; moe_bert=2)")
+    p.add_argument("--moe_aux_weight", type=float, default=None,
+                   help="MoE models: load-balancing aux-loss weight "
+                        "(default: the model's; moe_bert=0.01)")
+    p.add_argument("--moe_router_z_weight", type=float, default=None,
+                   help="MoE models: ST-MoE router z-loss weight "
+                        "(typ. 1e-3; 0 disables)")
+    p.add_argument("--moe_jitter", type=float, default=None,
+                   help="MoE models: router input noise amplitude "
+                        "U[1-j, 1+j], training only (typ. 0.01)")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="smooth training targets (image classifiers: "
                         "lenet/resnet20/resnet50; the standard ImageNet "
@@ -286,6 +298,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_capacity_factor=args.moe_capacity_factor,
+        moe_every=args.moe_every,
+        moe_aux_weight=args.moe_aux_weight,
+        moe_router_z_weight=args.moe_router_z_weight,
+        moe_jitter=args.moe_jitter,
         eval_every_steps=args.eval_every_steps,
         early_stop_metric=args.early_stop_metric,
         early_stop_patience=args.early_stop_patience,
@@ -529,7 +545,11 @@ def main(argv: list[str] | None = None) -> int:
             f"(lenet/resnet20/resnet50), not model {args.model!r}")
     for flag, val in (("--moe_experts", args.moe_experts),
                       ("--moe_top_k", args.moe_top_k),
-                      ("--moe_capacity_factor", args.moe_capacity_factor)):
+                      ("--moe_capacity_factor", args.moe_capacity_factor),
+                      ("--moe_every", args.moe_every),
+                      ("--moe_aux_weight", args.moe_aux_weight),
+                      ("--moe_router_z_weight", args.moe_router_z_weight),
+                      ("--moe_jitter", args.moe_jitter)):
         if val is not None and not args.model.startswith("moe_"):
             raise SystemExit(
                 f"{flag} is an MoE routing knob (moe_bert/"

@@ -222,6 +222,10 @@ class TrainConfig:
     moe_experts: int | None = None       # experts per MoE layer
     moe_top_k: int | None = None         # routed experts per token
     moe_capacity_factor: float | None = None
+    moe_every: int | None = None         # MoE FFN every k-th layer
+    moe_aux_weight: float | None = None  # load-balancing loss weight
+    moe_router_z_weight: float | None = None   # ST-MoE router z-loss
+    moe_jitter: float | None = None      # router noise U[1-j,1+j] (train)
     eval_every_steps: int = 0        # 0 => eval only at the end
     early_stop_metric: str | None = None  # stop when this eval metric
                                           # stops improving

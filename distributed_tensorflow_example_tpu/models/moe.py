@@ -36,7 +36,9 @@ class MoeBertConfig(BertConfig):
     top_k: int = 1
     capacity_factor: float = 1.25
     moe_every: int = 2            # MoE FFN every k-th layer (offset 1)
-    aux_weight: float = 0.01
+    aux_weight: float = 0.01      # load-balancing loss weight
+    router_z_weight: float = 0.0  # ST-MoE router z-loss weight (1e-3 typ.)
+    jitter: float = 0.0           # router input noise U[1-j, 1+j], train only
 
     @classmethod
     def tiny(cls) -> "MoeBertConfig":
@@ -81,17 +83,21 @@ class MoeBert(Bert):
     def _moe_layer(self, lp, h, mask, lrng, *, train: bool,
                    use_dropout: bool):
         """One MoE encoder layer: MHA -> add&LN -> MoE FFN -> add&LN.
-        Returns ``(h, aux)`` — pure in its array args so it can be
+        Returns ``(h, aux dict)`` — pure in its array args so it can be
         jax.checkpoint-wrapped like Bert._layer. The attention half and
         the FFN tail are shared with Bert (``_attn_block``/``_ffn_block``);
-        only the FFN body differs."""
+        only the FFN body differs. Router jitter engages only in training
+        WITH randomness (lrng comes from the step rng; eval passes
+        rng=None, so jittered eval is impossible by construction)."""
         c = self.cfg
         h = self._attn_block(lp, h, mask, lrng, train=train,
                              use_dropout=use_dropout)
+        jrng = (jax.random.fold_in(lrng, 3)
+                if train and c.jitter > 0 and lrng is not None else None)
         f, aux = moe.moe_ffn(lp["moe"], h,
                              n_experts=c.n_experts, top_k=c.top_k,
                              capacity_factor=c.capacity_factor,
-                             dtype=self.dtype)
+                             dtype=self.dtype, rng=jrng, jitter=c.jitter)
         return self._ffn_block(lp, h, f, lrng, use_dropout=use_dropout), aux
 
     def encode_with_aux(self, params, batch, rng=None, train: bool = False):
@@ -108,15 +114,26 @@ class MoeBert(Bert):
             functools.partial(self._moe_layer, train=train,
                               use_dropout=use_dropout))
 
-        aux_total = jnp.zeros((), jnp.float32)
+        aux_total = {
+            "lb_loss": jnp.zeros((), jnp.float32),
+            "z_loss": jnp.zeros((), jnp.float32),
+            "dropped_fraction": jnp.zeros((), jnp.float32),
+            "expert_load": jnp.zeros((c.n_experts,), jnp.float32),
+        }
+        n_moe = 0
         for i in range(c.layers):
             lp = params[f"layer_{i}"]
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             if self._is_moe_layer(i):
                 h, aux = moe_layer(lp, h, mask, lrng)
-                aux_total = aux_total + aux
+                aux_total = jax.tree_util.tree_map(jnp.add, aux_total, aux)
+                n_moe += 1
             else:
                 h = dense_layer(lp, h, mask, lrng)
+        # loss terms stay SUMS over layers (each layer's router is its
+        # own regularization target); visibility stats become means
+        for k in ("dropped_fraction", "expert_load"):
+            aux_total[k] = aux_total[k] / max(1, n_moe)
         return h, aux_total
 
     # ------------------------------------------------------------------
@@ -130,9 +147,21 @@ class MoeBert(Bert):
         pred = jnp.argmax(logits, axis=-1)
         acc = (jnp.sum((pred == batch["masked_labels"]) * w)
                / jnp.maximum(jnp.sum(w), 1.0))
-        total = mlm + self.cfg.aux_weight * aux
-        return total, ({"mlm_accuracy": acc, "mlm_loss": mlm,
-                        "aux_loss": aux}, new_extras)
+        total = (mlm + self.cfg.aux_weight * aux["lb_loss"]
+                 + self.cfg.router_z_weight * aux["z_loss"])
+        load = aux["expert_load"]
+        # the metrics stream is self-describing about routing health:
+        # dropped_token_fraction > 0 means capacity overflow is silently
+        # zeroing expert outputs; expert_load is the full [E] utilization
+        # vector (vector metrics flow to the JSONL, scalar hooks skip it)
+        metrics = {"mlm_accuracy": acc, "mlm_loss": mlm,
+                   "aux_loss": aux["lb_loss"],
+                   "router_z_loss": aux["z_loss"],
+                   "dropped_token_fraction": aux["dropped_fraction"],
+                   "expert_load": load,
+                   "expert_load_min": jnp.min(load),
+                   "expert_load_max": jnp.max(load)}
+        return total, (metrics, new_extras)
 
     # ------------------------------------------------------------------
     def sharding_rules(self, mesh_shape) -> ShardingRules:
@@ -152,8 +181,9 @@ class MoeBert(Bert):
 
 def _apply_moe_overrides(cfg: MoeBertConfig,
                          config: TrainConfig) -> MoeBertConfig:
-    """CLI-reachable routing knobs (--moe_experts/--moe_top_k/
-    --moe_capacity_factor); None keeps the model default."""
+    """CLI-reachable routing + training-quality knobs (--moe_experts/
+    --moe_top_k/--moe_capacity_factor/--moe_every/--moe_aux_weight/
+    --moe_router_z_weight/--moe_jitter); None keeps the model default."""
     if config.moe_experts is not None:
         if config.moe_experts < 1:
             raise ValueError(
@@ -174,6 +204,28 @@ def _apply_moe_overrides(cfg: MoeBertConfig,
                 "must be > 0 (capacity would clamp to 1 slot and drop "
                 "nearly every token)")
         cfg.capacity_factor = config.moe_capacity_factor
+    if config.moe_every is not None:
+        if not 1 <= config.moe_every <= cfg.layers:
+            raise ValueError(
+                f"moe_every={config.moe_every} must be in [1, layers="
+                f"{cfg.layers}] (larger would yield zero MoE layers)")
+        cfg.moe_every = config.moe_every
+    if config.moe_aux_weight is not None:
+        if config.moe_aux_weight < 0:
+            raise ValueError(
+                f"moe_aux_weight={config.moe_aux_weight} must be >= 0")
+        cfg.aux_weight = config.moe_aux_weight
+    if config.moe_router_z_weight is not None:
+        if config.moe_router_z_weight < 0:
+            raise ValueError(f"moe_router_z_weight="
+                             f"{config.moe_router_z_weight} must be >= 0")
+        cfg.router_z_weight = config.moe_router_z_weight
+    if config.moe_jitter is not None:
+        if not 0 <= config.moe_jitter < 1:
+            raise ValueError(
+                f"moe_jitter={config.moe_jitter} must be in [0, 1) "
+                "(multiplicative noise amplitude)")
+        cfg.jitter = config.moe_jitter
     return cfg
 
 

@@ -16,11 +16,13 @@ shape:
     -> {"model_version_status": [{"state": "AVAILABLE", ...}]}
 
 Batch-polymorphic artifacts (the export default) serve any instance
-count; static-batch artifacts (the MoE fallback) accept exactly their
-exported instance count, and a mismatch is a 400. This is a
-correctness/parity server, not a production QPS story: one worker,
-synchronous execution — the compute path is the same jitted StableHLO
-the offline servable runs.
+count; static-batch artifacts (the MoE fallback) serve any count UP TO
+their exported batch — the server pads the request to the exported
+batch (repeating the first instance; routing capacity is per-batch, so
+padding only dilutes it) and truncates the response back to the actual
+count. Above the exported batch is a 400. This is a correctness/parity
+server, not a production QPS story: one worker, synchronous execution —
+the compute path is the same jitted StableHLO the offline servable runs.
 """
 
 from __future__ import annotations
@@ -83,6 +85,7 @@ class PredictServer:
             raise ValueError(f"missing model inputs {sorted(missing)} "
                              f"(want {sorted(sig)})")
         out = {}
+        counts = set()
         for key, spec in sig.items():
             arr = np.asarray(cols[key], dtype=np.dtype(spec["dtype"]))
             want_tail = tuple(spec["shape"][1:])
@@ -90,21 +93,37 @@ class PredictServer:
                 raise ValueError(
                     f"input {key!r} has per-instance shape "
                     f"{arr.shape[1:]}, model wants {want_tail}")
-            if (not self.servable.meta.get("batch_polymorphic", True)
-                    and arr.shape[0] != spec["shape"][0]):
-                # static-batch artifact (e.g. MoE fallback): a wrong
-                # instance count is the CLIENT's error, not an opaque
-                # XLA 500
+            counts.add(arr.shape[0])
+            out[key] = arr
+        if len(counts) != 1:
+            raise ValueError(
+                f"inputs disagree on instance count: {sorted(counts)}")
+        n = counts.pop()
+        if not self.servable.meta.get("batch_polymorphic", True):
+            # static-batch artifact (e.g. MoE fallback): pad up to the
+            # exported batch and let predict() truncate — only MORE
+            # instances than the executable can take is the client's
+            # error. Padding repeats the first instance; MoE routing
+            # capacity is per-batch, so pad rows only dilute it (they
+            # can steal expert slots from real rows only when the real
+            # request would itself be near overflow).
+            b_exp = next(iter(sig.values()))["shape"][0]
+            if n > b_exp:
                 raise ValueError(
                     f"this artifact was exported with a static batch of "
-                    f"{spec['shape'][0]} instances; got {arr.shape[0]}")
-            out[key] = arr
-        return out
+                    f"{b_exp} instances; got {n} (requests up to {b_exp} "
+                    "are padded server-side)")
+            if n < b_exp:
+                out = {k: np.concatenate(
+                    [v, np.repeat(v[:1], b_exp - n, axis=0)])
+                    for k, v in out.items()}
+        return out, n
 
     def predict(self, payload: dict) -> dict:
-        feats = self._feature_arrays(payload)
+        feats, n = self._feature_arrays(payload)
         logits = np.asarray(self.servable(feats))
-        return {"predictions": logits.tolist()}
+        # truncate any server-side padding back to the client's count
+        return {"predictions": logits[:n].tolist()}
 
     def _make_handler(self):
         server = self
@@ -153,13 +172,15 @@ class PredictServer:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    feats = server._feature_arrays(payload)
+                    feats, count = server._feature_arrays(payload)
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(400, {"error": str(e)})  # client's fault
                     return
                 try:
                     logits = np.asarray(server.servable(feats))
-                    self._send(200, {"predictions": logits.tolist()})
+                    # static-batch artifacts were padded server-side:
+                    # return only the client's rows
+                    self._send(200, {"predictions": logits[:count].tolist()})
                 except Exception as e:                  # server's fault:
                     # platform mismatch, runtime OOM, ... must be a 500,
                     # not a dropped connection or a client-blaming 400

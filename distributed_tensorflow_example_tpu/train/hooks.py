@@ -67,7 +67,8 @@ class LoggingHook(Hook):
         if metrics is None or not self.wants_metrics(step) or not _is_chief():
             return
         keys = self.keys or [k for k in metrics if k != "step"]
-        body = " ".join(f"{k}={metrics[k]:.6g}" for k in keys if k in metrics)
+        body = " ".join(f"{k}={metrics[k]:.6g}" for k in keys
+                        if k in metrics and np.ndim(metrics[k]) == 0)
         log.info("step %d: %s", step, body)
 
 

@@ -44,6 +44,13 @@ from .state import TrainState, param_count
 log = get_logger("trainer")
 
 
+def _host_metric(v):
+    """Device metric -> JSON-ready host value: scalars become floats,
+    vectors (MoE per-expert load) become lists — the JSONL sink takes
+    them; scalar hooks skip them."""
+    return float(v) if np.ndim(v) == 0 else np.asarray(v).tolist()
+
+
 class Trainer:
     """End-to-end training driver for a registered model.
 
@@ -326,8 +333,9 @@ class Trainer:
                 wants = any(h.wants_metrics(step) for h in self.hooks)
                 host_metrics = None
                 if wants:
-                    host_metrics = {k: float(v) for k, v in
-                                    jax.device_get(device_metrics).items()}
+                    host_metrics = {
+                        k: _host_metric(v)
+                        for k, v in jax.device_get(device_metrics).items()}
                 for h in self.hooks:
                     if h.after_step(self, step, host_metrics):
                         stop = True
@@ -375,7 +383,8 @@ class Trainer:
         }
         if device_metrics is not None:
             summary["final_metrics"] = {
-                k: float(v) for k, v in jax.device_get(device_metrics).items()}
+                k: _host_metric(v)
+                for k, v in jax.device_get(device_metrics).items()}
         if self.eval_arrays is not None:
             if self._last_eval is not None and self._last_eval[0] == step:
                 # the loop just evaluated this exact step (early stop /

@@ -152,8 +152,8 @@ def main() -> int:
         np.asarray(multihost_utils.process_allgather(y_sm, tiled=True)),
         np.asarray(y_dense), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(
-        float(jax.device_get(aux_sm)), float(np.asarray(aux_dense)),
-        rtol=1e-5)
+        float(jax.device_get(aux_sm["lb_loss"])),
+        float(np.asarray(aux_dense["lb_loss"])), rtol=1e-5)
     rt.barrier("ep-parity-ok")
 
     # --- PP: ppermute across the host boundary ------------------------

@@ -87,8 +87,15 @@ def test_moe_dense_equals_shard_map(cpu8):
     np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
                                rtol=1e-5, atol=1e-6)
     # aux statistics are pmean'd to GLOBAL batch values before the formula
-    # (ADVICE r2 finding 4), so the two paths agree
-    np.testing.assert_allclose(float(aux_e), float(aux_d), rtol=1e-5)
+    # (ADVICE r2 finding 4), so the two paths agree — for the loss terms
+    # AND the visibility stats (nothing dropped; per-rank capacity divides
+    # evenly, so slot-utilization means match too)
+    for k in ("lb_loss", "z_loss", "dropped_fraction"):
+        np.testing.assert_allclose(float(aux_e[k]), float(aux_d[k]),
+                                   rtol=1e-5, atol=1e-7, err_msg=k)
+    np.testing.assert_allclose(np.asarray(aux_e["expert_load"]),
+                               np.asarray(aux_d["expert_load"]),
+                               rtol=1e-5, atol=1e-7)
 
 
 def test_moe_shard_map_top2(cpu8):
@@ -103,7 +110,8 @@ def test_moe_shard_map_top2(cpu8):
                                       batch_axes=("data",))
     np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
                                rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(float(aux_e), float(aux_d), rtol=1e-5)
+    np.testing.assert_allclose(float(aux_e["lb_loss"]),
+                               float(aux_d["lb_loss"]), rtol=1e-5)
 
 
 # ---------------------------------------------------------------------------
@@ -137,7 +145,7 @@ def test_moe_gradients_finite_router_nonzero():
 
     def loss_fn(p):
         out, aux = moe.moe_ffn(p, x, n_experts=4, capacity_factor=2.0)
-        return jnp.sum(jnp.square(out)) + aux
+        return jnp.sum(jnp.square(out)) + aux["lb_loss"] + aux["z_loss"]
 
     grads = jax.jit(jax.grad(loss_fn))(params)
     for leaf in jax.tree_util.tree_leaves(grads):
@@ -154,13 +162,13 @@ def test_moe_shard_map_gradients_match_dense(cpu8):
 
     def loss_dense(p):
         out, aux = moe.moe_ffn(p, x, n_experts=4, capacity_factor=8.0)
-        return jnp.sum(jnp.square(out)) + aux
+        return jnp.sum(jnp.square(out)) + aux["lb_loss"]
 
     def loss_ep(p):
         out, aux = moe.moe_ffn_shard_map(p, x, mesh, n_experts=4,
                                          capacity_factor=8.0,
                                          batch_axes=("data",))
-        return jnp.sum(jnp.square(out)) + aux
+        return jnp.sum(jnp.square(out)) + aux["lb_loss"]
 
     g_d = jax.jit(jax.grad(loss_dense))(params)
     g_e = jax.jit(jax.grad(loss_ep))(params)
@@ -302,3 +310,135 @@ def test_moe_bert_tiny_trains_top2(cpu8):
         state, metrics = sync.step(state, batch)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------------
+# training-quality mechanisms (VERDICT r3 weak #1 / task #5)
+# ---------------------------------------------------------------------------
+
+def test_router_z_loss_shrinks_router_logits():
+    """Training WITH the z-loss term must end with smaller router logit
+    norms than training without (the ST-MoE stabilization claim) — the
+    VERDICT 'done' criterion for the knob. Isolated to one MoE layer
+    starting from a deliberately large-logit router so the contrast is
+    deterministic (full-network SGD with an outsized z weight is exactly
+    the instability the z-loss exists to prevent — see the 1e-3-typical
+    weight on the CLI flag)."""
+    def final_z(zw, steps=100, lr=0.05):
+        params = _params()
+        params["router"]["kernel"] = params["router"]["kernel"] * 200.0
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(2, 8, 16).astype(np.float32))
+        target = jnp.asarray(rs.randn(2, 8, 16).astype(np.float32))
+
+        @jax.jit
+        def step(p):
+            def loss(q):
+                out, aux = moe.moe_ffn(q, x, n_experts=4,
+                                       capacity_factor=8.0)
+                return (jnp.mean(jnp.square(out - target))
+                        + zw * aux["z_loss"])
+            g = jax.grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g)
+
+        for _ in range(steps):
+            params = step(params)
+        _, aux = moe.moe_ffn(params, x, n_experts=4, capacity_factor=8.0)
+        return float(aux["z_loss"])
+
+    base = final_z(0.0)
+    assert final_z(0.1) < 0.5 * base, base
+
+
+def test_jitter_perturbs_routing_in_train_only():
+    params = _params()
+    rs = np.random.RandomState(7)
+    x = jnp.asarray(rs.randn(2, 8, 16).astype(np.float32))
+    base, _ = moe.moe_ffn(params, x, n_experts=4, capacity_factor=8.0)
+    # no rng -> jitter is inert regardless of the knob
+    off, _ = moe.moe_ffn(params, x, n_experts=4, capacity_factor=8.0,
+                         jitter=0.5)
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(base))
+    # rng + jitter -> routing (and thus the output) changes
+    on, _ = moe.moe_ffn(params, x, n_experts=4, capacity_factor=8.0,
+                        rng=jax.random.key(0), jitter=0.5)
+    assert np.abs(np.asarray(on) - np.asarray(base)).max() > 0
+
+
+def test_dropped_fraction_visible_at_tight_capacity():
+    params = _params()
+    params["router"]["kernel"] = jnp.zeros_like(params["router"]["kernel"])
+    rs = np.random.RandomState(8)
+    x = jnp.asarray(rs.randn(1, 8, 16).astype(np.float32))
+    # zero router: all 8 tokens to expert 0; capacity_factor 0.5 -> C=1:
+    # 7 of 8 assignments dropped
+    _, aux = moe.moe_ffn(params, x, n_experts=4, capacity_factor=0.5)
+    np.testing.assert_allclose(float(aux["dropped_fraction"]), 7 / 8)
+    np.testing.assert_allclose(np.asarray(aux["expert_load"]),
+                               [1.0, 0.0, 0.0, 0.0])
+    # generous capacity drops nothing
+    _, aux = moe.moe_ffn(params, x, n_experts=4, capacity_factor=8.0)
+    assert float(aux["dropped_fraction"]) == 0.0
+
+
+def test_moe_metrics_reach_the_stream():
+    """MoeBert.loss surfaces routing health into the metrics dict: the
+    scalars hooks print plus the full per-expert load vector."""
+    m = _tiny_moe()
+    params = m.init(jax.random.key(0))
+    _, (metrics, _) = m.loss(params, {}, m.dummy_batch(2),
+                             jax.random.key(1))
+    for k in ("router_z_loss", "dropped_token_fraction",
+              "expert_load_min", "expert_load_max"):
+        assert np.ndim(metrics[k]) == 0, k
+    assert metrics["expert_load"].shape == (m.cfg.n_experts,)
+    assert float(metrics["expert_load_min"]) <=         float(metrics["expert_load_max"])
+
+
+def test_new_moe_cli_knobs_reach_the_model():
+    cfg = TrainConfig(model="moe_bert_tiny", moe_every=1,
+                      moe_aux_weight=0.05, moe_router_z_weight=1e-3,
+                      moe_jitter=0.01)
+    m = get_model("moe_bert_tiny", cfg)
+    assert m.cfg.moe_every == 1
+    assert m.cfg.aux_weight == 0.05
+    assert m.cfg.router_z_weight == 1e-3
+    assert m.cfg.jitter == 0.01
+    # moe_every=1 -> EVERY layer is MoE
+    assert all(m._is_moe_layer(i) for i in range(m.cfg.layers))
+    with pytest.raises(ValueError, match="moe_every"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny", moe_every=99))
+    with pytest.raises(ValueError, match="moe_aux_weight"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny", moe_aux_weight=-1.0))
+    with pytest.raises(ValueError, match="moe_router_z_weight"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny",
+                              moe_router_z_weight=-0.1))
+    with pytest.raises(ValueError, match="moe_jitter"):
+        get_model("moe_bert_tiny",
+                  TrainConfig(model="moe_bert_tiny", moe_jitter=1.5))
+
+
+def test_moe_bert_trains_with_z_loss_and_jitter(cpu8):
+    """The full recipe (z-loss + jitter + metrics) trains end to end and
+    the vector metric survives the trainer's host conversion."""
+    cfg = TrainConfig(model="moe_bert_tiny", moe_router_z_weight=1e-3,
+                      moe_jitter=0.01)
+    m = get_model("moe_bert_tiny", cfg)
+    mesh = local_mesh(8, {"data": 2, "expert": 4})
+    tx = make_optimizer(OptimizerConfig(name="adamw", learning_rate=1e-3))
+    sync = SyncReplicas(m.loss, tx, mesh,
+                        rules=m.sharding_rules(MeshShape(data=2, expert=4)))
+    state = sync.init(m.init)
+    batch = sync.shard_batch(m.dummy_batch(16))
+    losses = []
+    for _ in range(6):
+        state, metrics = sync.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    host = {k: (float(v) if np.ndim(v) == 0 else np.asarray(v).tolist())
+            for k, v in jax.device_get(metrics).items()}
+    assert isinstance(host["expert_load"], list)
+    assert len(host["expert_load"]) == 4
