@@ -8,6 +8,12 @@ devices are present (the driver runs it on one real TPU chip):
 - ``mnist_mlp``   — the reference-parity workload (BASELINE.json:7)
 - ``resnet50``    — ImageNet shapes, bf16, synthetic data (BASELINE.json:10)
 - ``bert_base``   — MLM step time, seq 128 (BASELINE.json:11)
+- ``moe_bert``    — expert-parallel flagship, 8 experts top-1, b64
+- ``bert_large``  — the big dense model, b64
+- ``bert_long``   — composed long context: S=4096 flash + remat=full, b4
+
+The last three are this repo's own flagship capabilities (VERDICT r3
+task #3): a regression in any of the six moves ``vs_baseline``.
 
 For each, an MFU estimate = XLA-reported FLOPs for the compiled step /
 measured step time / chip peak (bf16) is recorded. The reference publishes
@@ -104,6 +110,7 @@ def robust_time(timed_pass, *, steps: int, flops=None, peak=None,
 
 def _run(model_name: str, *, batch: int, steps: int, warmup: int,
          opt: OptimizerConfig, make_batch, extra_cfg: dict | None = None,
+         cfg_over: dict | None = None,
          steps_per_call: int = 1, prng_impl: str | None = None):
     """Time `steps` sync steps; returns (examples/sec/chip, step_ms, mfu,
     suspect) — ``suspect`` marks a measurement robust_time could not
@@ -119,7 +126,7 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     cfg = TrainConfig(model=model_name, dtype="bfloat16",
                       data=DataConfig(batch_size=batch,
                                       **(extra_cfg or {})),
-                      optimizer=opt)
+                      optimizer=opt, **(cfg_over or {}))
     model = get_model(model_name, cfg)
     tx = make_optimizer(cfg.optimizer)
     sync = SyncReplicas(model.loss, tx, mesh)
@@ -177,65 +184,106 @@ def _dummy_batch(model, batch, i):
     return model.dummy_batch(batch)
 
 
+def _long_batch(model, batch, i):
+    """BERT batch at the model's FULL configured sequence length
+    (dummy_batch caps at 128 for the seq-128 workloads)."""
+    c = model.cfg
+    s = c.max_len
+    m = c.max_predictions
+    rs = np.random.RandomState(i)
+    return {
+        "input_ids": rs.randint(0, c.vocab_size, (batch, s),
+                                dtype=np.int32),
+        "token_type_ids": np.zeros((batch, s), np.int32),
+        "attention_mask": np.ones((batch, s), np.int32),
+        "masked_positions": np.tile(np.arange(m, dtype=np.int32),
+                                    (batch, 1)),
+        "masked_labels": rs.randint(0, c.vocab_size, (batch, m),
+                                    dtype=np.int32),
+        "masked_weights": np.ones((batch, m), np.float32),
+    }
+
+
+def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
+    """The gate workload table. ``only``: BENCH_ONLY aliases; ``key``:
+    the extra/baseline prefix. Off-TPU, transformer workloads swap in
+    tiny model variants (sanity only, numbers meaningless).
+
+    Config notes that earned their place:
+    - mnist: 1000 steps = 50 measured dispatches — 10 dispatches left
+      the number at the mercy of axon-tunnel latency jitter (observed
+      12.8M-15.0M swings; BASELINE.md "discrepancy" note).
+    - bert @ b128: the v5e sweet spot (mfu 0.382 @ 64 -> 0.410 @ 128 ->
+      0.383 @ 256 measured r3); rbg = TPU-native RNG (dropout masks
+      dominate threefry's cost: 112.4 -> 89.1 ms/step measured).
+    - moe_bert/bert_large @ b64: the measured sweet spots (BASELINE.md).
+    - bert_long: the composed long-context capability (flash +
+      remat=full @ S=4096 b4 — the regime the plain XLA path cannot
+      reach); its MFU is vs the flash-kernel cost analysis and NOT
+      comparable to the seq-128 rows.
+    """
+    adamw = OptimizerConfig(name="adamw", learning_rate=1e-4)
+    rbg = "rbg" if on_tpu else None
+    return [
+        dict(key="mnist_mlp", only={"mnist"}, model="mlp", batch=8192,
+             steps=1000 if on_tpu else 10, warmup=100 if on_tpu else 2,
+             opt=OptimizerConfig(name="sgd", learning_rate=0.5),
+             make_batch=_mnist_batch,
+             steps_per_call=20 if on_tpu else 5, ms_digits=3),
+        dict(key="resnet50", only={"resnet50"}, model="resnet50",
+             batch=max(8, 128 // scale), steps=30 if on_tpu else 3,
+             warmup=5 if on_tpu else 1,
+             opt=OptimizerConfig(name="momentum", learning_rate=0.1),
+             make_batch=_dummy_batch),
+        dict(key="bert_base", only={"bert"}, model="bert",
+             batch=max(8, 128 // scale), steps=20 if on_tpu else 2,
+             warmup=5 if on_tpu else 1, opt=adamw,
+             make_batch=_dummy_batch, prng_impl=rbg),
+        dict(key="moe_bert", only={"moe", "moe_bert"},
+             model="moe_bert" if on_tpu else "moe_bert_tiny",
+             batch=max(8, 64 // scale), steps=20 if on_tpu else 2,
+             warmup=5 if on_tpu else 1, opt=adamw,
+             make_batch=_dummy_batch, prng_impl=rbg),
+        dict(key="bert_large", only={"bert_large"},
+             model="bert_large" if on_tpu else "bert_tiny",
+             batch=max(8, 64 // scale), steps=20 if on_tpu else 2,
+             warmup=5 if on_tpu else 1, opt=adamw,
+             make_batch=_dummy_batch, prng_impl=rbg),
+        dict(key="bert_long", only={"bert_long"},
+             model="bert" if on_tpu else "bert_tiny",
+             batch=4 if on_tpu else 2, steps=8 if on_tpu else 1,
+             warmup=2 if on_tpu else 1, opt=adamw,
+             make_batch=_long_batch,
+             extra_cfg={"seq_len": 4096 if on_tpu else 256},
+             cfg_over={"attention_impl": "flash", "remat": "full"},
+             prng_impl=rbg, eps_digits=2),
+    ]
+
+
 def main() -> None:
     only = os.environ.get("BENCH_ONLY", "").split(",") if \
         os.environ.get("BENCH_ONLY") else None
     on_tpu = jax.devices()[0].platform == "tpu"
-    # CPU fallback (bench sanity off-chip): tiny sizes, numbers meaningless
     scale = 1 if on_tpu else 16
 
     extra: dict[str, float | None] = {}
-
-    if only is None or "mnist" in only:
-        # 1000 steps = 50 measured dispatches: at 0.55 ms/step the whole
-        # measurement is ~0.6 s, and 10 dispatches (the old 200-step run)
-        # left the number at the mercy of axon-tunnel latency jitter
-        # (observed 12.8M-15.0M eps swings; BASELINE.md "discrepancy" note)
+    for w in _workloads(on_tpu, scale):
+        if only is not None and not (w["only"] & set(only)):
+            continue
+        key = w["key"]
         eps, ms, mfu, suspect = _run(
-            "mlp", batch=8192, steps=1000 if on_tpu else 10,
-            warmup=100 if on_tpu else 2,
-            opt=OptimizerConfig(name="sgd", learning_rate=0.5),
-            make_batch=_mnist_batch,
-            steps_per_call=20 if on_tpu else 5)
-        extra["mnist_mlp_eps_chip"] = round(eps, 1)
-        extra["mnist_mlp_step_ms"] = round(ms, 3)
+            w["model"], batch=w["batch"], steps=w["steps"],
+            warmup=w["warmup"], opt=w["opt"],
+            make_batch=w["make_batch"],
+            extra_cfg=w.get("extra_cfg"), cfg_over=w.get("cfg_over"),
+            steps_per_call=w.get("steps_per_call", 1),
+            prng_impl=w.get("prng_impl"))
+        extra[f"{key}_eps_chip"] = round(eps, w.get("eps_digits", 1))
+        extra[f"{key}_step_ms"] = round(ms, w.get("ms_digits", 2))
         if mfu:
-            extra["mnist_mlp_mfu"] = round(mfu, 4)
+            extra[f"{key}_mfu"] = round(mfu, 4)
         if suspect:
-            extra["mnist_mlp_suspect"] = True
-
-    if only is None or "resnet50" in only:
-        eps, ms, mfu, suspect = _run(
-            "resnet50", batch=max(8, 128 // scale),
-            steps=30 if on_tpu else 3, warmup=5 if on_tpu else 1,
-            opt=OptimizerConfig(name="momentum", learning_rate=0.1),
-            make_batch=_dummy_batch)
-        extra["resnet50_eps_chip"] = round(eps, 1)
-        extra["resnet50_step_ms"] = round(ms, 2)
-        if mfu:
-            extra["resnet50_mfu"] = round(mfu, 4)
-        if suspect:
-            extra["resnet50_suspect"] = True
-
-    if only is None or "bert" in only:
-        # batch 128 is the v5e sweet spot (measured r3: mfu 0.382 @ 64 →
-        # 0.410 @ 128 → 0.383 @ 256): Adam's ~10 ms of weight traffic is
-        # batch-independent, so bigger global batch amortizes it until
-        # attention score tensors start spilling
-        # rbg = the TPU-native RNG (--prng_impl rbg): dropout-mask
-        # generation dominates threefry's TPU cost — measured 112.4 ->
-        # 89.1 ms/step on this exact config (BASELINE.md round 3)
-        eps, ms, mfu, suspect = _run(
-            "bert", batch=max(8, 128 // scale),
-            steps=20 if on_tpu else 2, warmup=5 if on_tpu else 1,
-            opt=OptimizerConfig(name="adamw", learning_rate=1e-4),
-            make_batch=_dummy_batch, prng_impl="rbg" if on_tpu else None)
-        extra["bert_base_eps_chip"] = round(eps, 1)
-        extra["bert_base_step_ms"] = round(ms, 2)
-        if mfu:
-            extra["bert_base_mfu"] = round(mfu, 4)
-        if suspect:
-            extra["bert_base_suspect"] = True
+            extra[f"{key}_suspect"] = True
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "bench_baseline.json")
@@ -254,7 +302,10 @@ def main() -> None:
     ratios = []
     for key, b in (("mnist_mlp_eps_chip", mnist_base),
                    ("resnet50_eps_chip", base.get("resnet50_eps_chip")),
-                   ("bert_base_eps_chip", base.get("bert_base_eps_chip"))):
+                   ("bert_base_eps_chip", base.get("bert_base_eps_chip")),
+                   ("moe_bert_eps_chip", base.get("moe_bert_eps_chip")),
+                   ("bert_large_eps_chip", base.get("bert_large_eps_chip")),
+                   ("bert_long_eps_chip", base.get("bert_long_eps_chip"))):
         if extra.get(key) and b:
             ratios.append(extra[key] / b)
     vs = float(np.prod(ratios) ** (1 / len(ratios))) if ratios else 1.0

@@ -23,11 +23,14 @@ import jax
 import ml_dtypes
 import numpy as np
 
+from ..utils.logging import get_logger
 from ..utils.pytree import is_prng_key as _is_key, path_str as _path_str
 from .checkpoint import (PREFIX, STATE_FILE, _leaf_from_pieces,
                          _merge_metas)
 
 PyTree = Any
+
+_log = get_logger("warm_start")
 
 
 def load_checkpoint_arrays(ckpt: str, step: int | None = None
@@ -134,6 +137,21 @@ def warm_start(params: PyTree, ckpt: str,
         raise ValueError(
             f"checkpoint {ckpt!r} holds no {ckpt_scope!r} leaves "
             f"(keys: {sorted(arrays)[:8]}...)")
+
+    # a typo'd checkpoint-scope prefix would otherwise leave every
+    # matching model path fresh with no signal (ADVICE r3 #5) — louder
+    # than tf.train.init_from_checkpoint: a WARNING under the default
+    # partial-restore contract (a scope may legitimately target a head
+    # the checkpoint doesn't carry), a hard error under require_all
+    for ck_prefix in assignment_map:
+        if not any(k.startswith(ck_prefix) for k in available):
+            msg = (f"warm start: assignment-map checkpoint scope "
+                   f"{ck_prefix!r} matches no checkpoint key (have e.g. "
+                   f"{sorted(available)[:5]}...)")
+            if require_all:
+                raise ValueError(msg)
+            _log.warning("%s — the mapped model paths stay at their "
+                         "fresh init", msg)
 
     restored: list[str] = []
     fresh: list[str] = []

@@ -174,6 +174,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["float32", "bfloat16"],
                    help="parameter storage dtype (f32 default; bf16 halves "
                         "param/optimizer HBM at some precision cost)")
+    p.add_argument("--bn_stats_dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="BatchNorm batch-statistic reduction dtype (conv "
+                        "models; running stats stay f32 — the ResNet "
+                        "byte-roofline experiment knob)")
     p.add_argument("--mesh", default="",
                    help="axis sizes, e.g. 'data=4,model=2' (default: all "
                         "devices on the data axis)")
@@ -310,6 +315,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         seed=args.seed,
         dtype=args.dtype,
         param_dtype=args.param_dtype,
+        bn_stats_dtype=args.bn_stats_dtype,
         attention_impl=args.attention,
         remat=args.remat,
         prng_impl=args.prng_impl,

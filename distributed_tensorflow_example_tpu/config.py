@@ -241,6 +241,8 @@ class TrainConfig:
     seed: int = 0
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     param_dtype: str = "float32"
+    bn_stats_dtype: str = "float32"  # BN batch-statistic reduction dtype
+                                     # (conv models; running stats stay f32)
     attention_impl: str = "xla"      # xla | flash (pallas kernel; long-seq)
     remat: str = "none"              # none | full | dots — jax.checkpoint
                                      # each transformer layer (HBM for

@@ -120,11 +120,15 @@ def is_gzipped(path: str) -> bool:
         return f.read(3) == b"\x1f\x8b\x08"
 
 
-def tfrecord_iterator(path: str, *, verify: bool = False
+def tfrecord_iterator(path: str, *, verify: bool = True
                       ) -> Iterator[bytes]:
     """Stream records from a TFRecord file
-    (``tf.compat.v1.io.tf_record_iterator`` parity). ``verify`` checks
-    both per-record CRCs and raises ValueError on corruption.
+    (``tf.compat.v1.io.tf_record_iterator`` parity). ``verify`` (the
+    default, matching the reference RecordReader's always-on masked-CRC
+    validation — a silently corrupt shard must fail, not feed garbage
+    into training; CRC-32C runs in C++ when the native library is
+    loaded) checks both per-record CRCs and raises ValueError on
+    corruption; pass ``verify=False`` as an explicit opt-out.
     GZIP-compressed files (TFRecordOptions GZIP) are detected by magic
     and streamed through decompression (sequential access only — the
     random-access/offset paths reject gzip with a clear error)."""
@@ -196,7 +200,7 @@ class TFRecordFile:
     the GIL — and by a Python pass otherwise.
     """
 
-    def __init__(self, path: str, *, verify: bool = False):
+    def __init__(self, path: str, *, verify: bool = True):
         self.path = path
         if native.available():
             self._offsets, self._lengths = native.tfrecord_index(
@@ -410,7 +414,7 @@ def write_examples(path: str, examples: "list[dict[str, Any]]") -> None:
 
 
 def load_token_records(paths: "list[str]", feature: str = "input_ids",
-                       *, verify: bool = False) -> np.ndarray:
+                       *, verify: bool = True) -> np.ndarray:
     """[N, S] int32 token matrix from TFRecords of Examples — the BERT
     pretraining data format (create_pretraining_data-style files). All
     records must carry ``feature`` with one fixed length."""
@@ -452,9 +456,11 @@ def split_shards(data_dir: str, split: str) -> "list[str]":
             names = sorted(os.listdir(data_dir))
         except OSError:
             return []
+        # delimiter-or-nothing after the prefix: 'train' must not
+        # sweep in 'trainer_debug.tfrecord' (ADVICE r3 #4)
         pat = re.compile(
             rf"{re.escape(prefix)}(-\d+-of-\d+(\.tfrecord)?"
-            rf"|.*\.tfrecord)$")
+            rf"|([._-].*)?\.tfrecord)$")
         return [os.path.join(data_dir, n) for n in names
                 if pat.fullmatch(n)]
 

@@ -24,11 +24,14 @@ from .base import (DefaultRulesMixin, cast_floating,
                    resolve_dtype)
 
 
-def _bn_apply(params, extras, x, *, train, momentum=0.9):
+def _bn_apply(params, extras, x, *, train, momentum=0.9,
+              stats_dtype=jnp.float32):
     # x keeps its compute dtype (bf16): nn.batchnorm takes statistics in
-    # f32 internally and normalizes in x.dtype, so activations never round-
-    # trip HBM as f32 (the pre-round-3 upcast here cost ~40% of step time)
-    return nn.batchnorm(params, extras, x, train=train, momentum=momentum)
+    # stats_dtype internally (f32 default) and normalizes in x.dtype, so
+    # activations never round-trip HBM as f32 (the pre-round-3 upcast
+    # here cost ~40% of step time)
+    return nn.batchnorm(params, extras, x, train=train, momentum=momentum,
+                        stats_dtype=stats_dtype)
 
 
 class _BasicBlock:
@@ -54,17 +57,21 @@ class _BasicBlock:
         return params, extras, out_ch
 
     @staticmethod
-    def apply(params, extras, x, *, stride, train, dtype):
+    def apply(params, extras, x, *, stride, train, dtype,
+              bn_stats_dtype=jnp.float32):
         new = {}
         h = nn.conv2d(params["conv1"], x, stride=stride, dtype=dtype)
-        h, new["bn1"] = _bn_apply(params["bn1"], extras["bn1"], h, train=train)
+        h, new["bn1"] = _bn_apply(params["bn1"], extras["bn1"], h, train=train,
+                                  stats_dtype=bn_stats_dtype)
         h = jax.nn.relu(h)
         h = nn.conv2d(params["conv2"], h, dtype=dtype)
-        h, new["bn2"] = _bn_apply(params["bn2"], extras["bn2"], h, train=train)
+        h, new["bn2"] = _bn_apply(params["bn2"], extras["bn2"], h, train=train,
+                                  stats_dtype=bn_stats_dtype)
         if "proj" in params:
             s = nn.conv2d(params["proj"], x, stride=stride, dtype=dtype)
             s, new["proj_bn"] = _bn_apply(params["proj_bn"],
-                                          extras["proj_bn"], s, train=train)
+                                          extras["proj_bn"], s, train=train,
+                                          stats_dtype=bn_stats_dtype)
         else:
             s = x.astype(h.dtype)
         return jax.nn.relu(h + s), new
@@ -95,20 +102,25 @@ class _BottleneckBlock:
         return params, extras, out_ch
 
     @staticmethod
-    def apply(params, extras, x, *, stride, train, dtype):
+    def apply(params, extras, x, *, stride, train, dtype,
+              bn_stats_dtype=jnp.float32):
         new = {}
         h = nn.conv2d(params["conv1"], x, dtype=dtype)
-        h, new["bn1"] = _bn_apply(params["bn1"], extras["bn1"], h, train=train)
+        h, new["bn1"] = _bn_apply(params["bn1"], extras["bn1"], h, train=train,
+                                  stats_dtype=bn_stats_dtype)
         h = jax.nn.relu(h)
         h = nn.conv2d(params["conv2"], h, stride=stride, dtype=dtype)
-        h, new["bn2"] = _bn_apply(params["bn2"], extras["bn2"], h, train=train)
+        h, new["bn2"] = _bn_apply(params["bn2"], extras["bn2"], h, train=train,
+                                  stats_dtype=bn_stats_dtype)
         h = jax.nn.relu(h)
         h = nn.conv2d(params["conv3"], h, dtype=dtype)
-        h, new["bn3"] = _bn_apply(params["bn3"], extras["bn3"], h, train=train)
+        h, new["bn3"] = _bn_apply(params["bn3"], extras["bn3"], h, train=train,
+                                  stats_dtype=bn_stats_dtype)
         if "proj" in params:
             s = nn.conv2d(params["proj"], x, stride=stride, dtype=dtype)
             s, new["proj_bn"] = _bn_apply(params["proj_bn"],
-                                          extras["proj_bn"], s, train=train)
+                                          extras["proj_bn"], s, train=train,
+                                          stats_dtype=bn_stats_dtype)
         else:
             s = x.astype(h.dtype)
         return jax.nn.relu(h + s), new
@@ -126,7 +138,8 @@ class ResNet(DefaultRulesMixin):
     def __init__(self, name: str, block, stage_sizes: Sequence[int],
                  widths: Sequence[int], num_classes: int,
                  input_hw: int, imagenet_stem: bool, dtype=jnp.float32,
-                 param_dtype=jnp.float32, label_smoothing: float = 0.0):
+                 param_dtype=jnp.float32, label_smoothing: float = 0.0,
+                 bn_stats_dtype=jnp.float32):
         self.name = name
         self.block = block
         self.stage_sizes = list(stage_sizes)
@@ -139,6 +152,9 @@ class ResNet(DefaultRulesMixin):
         # the standard ImageNet recipe smooths training targets (eval
         # metrics stay unsmoothed — comparable across smoothing settings)
         self.label_smoothing = label_smoothing
+        # --bn_stats_dtype experiment knob: batch-statistic reduction
+        # dtype (running stats stay f32 regardless — they accumulate)
+        self.bn_stats_dtype = bn_stats_dtype
 
     # ------------------------------------------------------------------
     def init(self, rng: jax.Array):
@@ -178,7 +194,8 @@ class ResNet(DefaultRulesMixin):
                       stride=2 if self.imagenet_stem else 1,
                       dtype=self.dtype)
         h, new["stem_bn"] = _bn_apply(params["stem_bn"], extras["stem_bn"],
-                                      h, train=train)
+                                      h, train=train,
+                                      stats_dtype=self.bn_stats_dtype)
         h = jax.nn.relu(h)
         if self.imagenet_stem:
             h = nn.max_pool(h, 3, 2, padding="SAME")
@@ -189,7 +206,8 @@ class ResNet(DefaultRulesMixin):
                 key = f"s{si}b{bi}"
                 h, new[key] = self.block.apply(
                     params[key], extras[key], h, stride=stride,
-                    train=train, dtype=self.dtype)
+                    train=train, dtype=self.dtype,
+                    bn_stats_dtype=self.bn_stats_dtype)
 
         h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))   # global avg pool
         logits = nn.dense(params["fc"], h, dtype=self.dtype)
@@ -220,13 +238,22 @@ class ResNet(DefaultRulesMixin):
         }
 
 
+def _bn_stats_dtype(config: TrainConfig):
+    if config.bn_stats_dtype not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"bn_stats_dtype={config.bn_stats_dtype!r} must be float32 "
+            "or bfloat16")
+    return resolve_dtype(config.bn_stats_dtype)
+
+
 @register_model("resnet20")
 def _make_resnet20(config: TrainConfig) -> ResNet:
     return ResNet("resnet20", _BasicBlock, [3, 3, 3], [16, 32, 64],
                   num_classes=10, input_hw=32, imagenet_stem=False,
                   dtype=resolve_dtype(config.dtype),
                   param_dtype=resolve_dtype(config.param_dtype),
-                  label_smoothing=config.label_smoothing)
+                  label_smoothing=config.label_smoothing,
+                  bn_stats_dtype=_bn_stats_dtype(config))
 
 
 @register_model("resnet50")
@@ -235,4 +262,5 @@ def _make_resnet50(config: TrainConfig) -> ResNet:
                   [64, 128, 256, 512], num_classes=1000, input_hw=224,
                   imagenet_stem=True, dtype=resolve_dtype(config.dtype),
                   param_dtype=resolve_dtype(config.param_dtype),
-                  label_smoothing=config.label_smoothing)
+                  label_smoothing=config.label_smoothing,
+                  bn_stats_dtype=_bn_stats_dtype(config))

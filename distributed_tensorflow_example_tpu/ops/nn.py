@@ -168,8 +168,8 @@ def batchnorm_init(dim: int, *, param_dtype=jnp.float32
 
 
 def batchnorm(params: Params, extras: Params, x: jax.Array, *,
-              train: bool, momentum: float = 0.9, eps: float = 1e-5
-              ) -> tuple[jax.Array, Params]:
+              train: bool, momentum: float = 0.9, eps: float = 1e-5,
+              stats_dtype=jnp.float32) -> tuple[jax.Array, Params]:
     """BatchNorm over N,H,W (all but last). In the auto sync mode the batch
     dim is globally sharded, so these are global-batch statistics (sync-BN).
     Under ``sync.mode='shard_map'`` the mean/var here are taken over the
@@ -178,16 +178,25 @@ def batchnorm(params: Params, extras: Params, x: jax.Array, *,
     are excluded from the auto==shard_map equivalence claim; see
     ``parallel.sync_replicas``. Returns (y, new_extras).
 
-    Mixed precision: statistics and running stats are always f32 (they
-    accumulate), but the normalization is applied in ``x.dtype`` via a
-    folded per-channel scale/offset — bf16 activations stay bf16 end to
-    end, halving the HBM bytes of the BN/relu/residual chain (the ResNet
-    bottleneck on TPU is bandwidth, not MXU flops)."""
+    Mixed precision: batch statistics are taken in ``stats_dtype``
+    (f32 default; the ``--bn_stats_dtype bfloat16`` experiment trades
+    reduction precision for bytes — measured on-chip in BASELINE.md's
+    ResNet roofline table), running stats are ALWAYS accumulated f32
+    (they integrate across thousands of steps), and the normalization is
+    applied in ``x.dtype`` via a folded per-channel scale/offset — bf16
+    activations stay bf16 end to end, halving the HBM bytes of the
+    BN/relu/residual chain (the ResNet bottleneck on TPU is bandwidth,
+    not MXU flops)."""
     if train:
         axes = tuple(range(x.ndim - 1))
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=axes)
-        var = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean)
+        xf = x.astype(stats_dtype)
+        mean = jnp.mean(xf, axis=axes).astype(jnp.float32)
+        # clamp: E[x^2] - mean^2 can round NEGATIVE (catastrophically so
+        # in bf16 stats when |mean| >> std), and rsqrt(negative + eps)
+        # would poison the step AND the persistent running var with NaN
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=axes).astype(jnp.float32)
+            - jnp.square(mean), 0.0)
         new_extras = {
             "mean": momentum * extras["mean"] + (1 - momentum) * mean,
             "var": momentum * extras["var"] + (1 - momentum) * var,

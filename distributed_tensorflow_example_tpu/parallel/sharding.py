@@ -137,18 +137,26 @@ def state_shardings(mesh: Mesh, state: PyTree,
 
     def spec(path, x) -> NamedSharding:
         shape = np.shape(x)
+        pstr = _path_str(path)
         if len(shape) == 0:
             return NamedSharding(mesh, P())
-        s = rules.spec_for(_path_str(path), shape)
+        s = rules.spec_for(pstr, shape)
         # "when shapes match", enforced: factored optimizer state
         # (adafactor's v_row/v_col vectors and (1,) placeholders)
         # embeds param PATHS at other ranks/sizes — a kernel rule's
         # spec cannot apply to those leaves, so they replicate instead
-        # of failing placement. Params themselves always match their
-        # own rules, so this only relaxes derived state
+        # of failing placement. The relaxation is for DERIVED state
+        # only: a rule-matched leaf under params/ falling back would
+        # silently replicate a real parameter (quiet perf/memory
+        # regression), so that stays a loud error (ADVICE r3 #2)
         if len(s) > len(shape) or any(
                 s[i] is not None and shape[i] % _axes_size(mesh, s[i])
                 for i in range(len(s))):
+            if "params/" in pstr or pstr.startswith("params"):
+                raise ValueError(
+                    f"sharding rule spec {s} does not fit param "
+                    f"{pstr!r} with shape {shape} (axis size must "
+                    "divide the dim); fix the rule or the mesh shape")
             return NamedSharding(mesh, P())
         return NamedSharding(mesh, s)
 

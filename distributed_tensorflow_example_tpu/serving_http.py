@@ -107,6 +107,13 @@ class PredictServer:
             # capacity is per-batch, so pad rows only dilute it (they
             # can steal expert slots from real rows only when the real
             # request would itself be near overflow).
+            # NOTE: Switch-MoE predictions are inherently batch-
+            # composition-dependent (routing capacity is per batch), so
+            # a padded request is exactly as valid as any other batch
+            # the real rows could have shared — but at tight capacity
+            # identical pad rows CAN crowd an expert and degrade the
+            # real rows; export with headroom (capacity_factor) if
+            # serving small requests against a static batch
             b_exp = next(iter(sig.values()))["shape"][0]
             if n > b_exp:
                 raise ValueError(

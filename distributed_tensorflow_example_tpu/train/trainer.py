@@ -435,6 +435,15 @@ class Trainer:
                 f"early_stop_metric={metric!r} is not an eval metric "
                 f"(eval produced {sorted(ev)})")
         value = float(ev[metric])
+        if jax.process_count() > 1:
+            # cross-host agreement: the verdict chain (best/misses/stop)
+            # must be identical on every process or a bitwise eval
+            # divergence desynchronizes the training loops (hang at the
+            # next collective) — same discipline as save_best's
+            # broadcast (ADVICE r3 #3)
+            from jax.experimental import multihost_utils
+            value = float(multihost_utils.broadcast_one_to_all(
+                np.float64(value)))
         better = (not math.isnan(value)) and (
             self._early_best is None
             or (value > self._early_best

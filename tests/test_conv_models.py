@@ -150,3 +150,31 @@ def test_resnet50_eval_reports_top5():
     p20, e20 = out if isinstance(out, tuple) else (out, {})
     assert "top5_accuracy" in jax.jit(m20.eval_metrics)(
         p20, e20, m20.dummy_batch(4))
+
+
+def test_bn_stats_dtype_knob(cpu8):
+    """--bn_stats_dtype bfloat16 (the ResNet byte-roofline experiment,
+    VERDICT r3 task #4): the knob reaches the BN batch-statistic
+    reduction, training still converges on CIFAR-scale ResNet-20, and
+    running stats stay f32. Invalid values are a hard error."""
+    import pytest as _pytest
+    cfg = TrainConfig(model="resnet20", bn_stats_dtype="bfloat16")
+    m = get_model("resnet20", cfg)
+    import jax.numpy as jnp
+    assert m.bn_stats_dtype == jnp.bfloat16
+    mesh = local_mesh(8)
+    tx = make_optimizer(OptimizerConfig(name="momentum", learning_rate=0.05))
+    sync = SyncReplicas(m.loss, tx, mesh)
+    state = sync.init(m.init, seed=0)
+    batch = sync.shard_batch(m.dummy_batch(64))
+    losses = []
+    for _ in range(8):
+        state, metrics = sync.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    # running stats accumulate in f32 regardless of the reduction dtype
+    for leaf in jax.tree_util.tree_leaves(state.extras):
+        assert leaf.dtype == np.float32, leaf.dtype
+    with _pytest.raises(ValueError, match="bn_stats_dtype"):
+        get_model("resnet20", TrainConfig(model="resnet20",
+                                          bn_stats_dtype="float16"))

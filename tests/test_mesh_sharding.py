@@ -75,3 +75,28 @@ def test_shard_params_fsdp_layout(cpu8):
     assert {s.data.shape for s in placed["w"].addressable_shards} == {(16, 4)}
     # b replicated
     assert {s.data.shape for s in placed["b"].addressable_shards} == {(32,)}
+
+
+def test_state_shardings_strict_for_params_relaxed_for_derived(cpu8):
+    """A rule-matched PARAM whose dim doesn't divide the axis is a loud
+    placement error (silent replication would be a quiet perf/memory
+    regression); the same mismatch on a DERIVED opt-state leaf (e.g.
+    adafactor's factored vectors) still relaxes to replicated
+    (ADVICE r3 #2)."""
+    from distributed_tensorflow_example_tpu.parallel.sharding import (
+        ShardingRules, state_shardings)
+    mesh = local_mesh(8, {"data": 2, "model": 4})
+    rules = ShardingRules(rules=[(r"kernel", P(None, "model"))])
+    # params: 6 % 4 != 0 -> loud
+    bad_state = {"params": {"layer": {"kernel": jnp.zeros((4, 6))}}}
+    with pytest.raises(ValueError, match="does not fit param"):
+        state_shardings(mesh, bad_state, rules)
+    # derived opt-state with the same path fragment -> replicated, no error
+    derived = {"opt_state": {"mu": {"layer": {"kernel": jnp.zeros((4, 6))}}}}
+    sh = state_shardings(mesh, derived, rules)
+    leaf = sh["opt_state"]["mu"]["layer"]["kernel"]
+    assert leaf.spec == P()
+    # divisible params place normally
+    ok_state = {"params": {"layer": {"kernel": jnp.zeros((4, 8))}}}
+    sh = state_shardings(mesh, ok_state, rules)
+    assert sh["params"]["layer"]["kernel"].spec == P(None, "model")

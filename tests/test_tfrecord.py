@@ -68,10 +68,12 @@ def test_corruption_detected(tmp_path):
     raw = bytearray(open(path, "rb").read())
     raw[14] ^= 0xFF                       # flip a data byte
     open(path, "wb").write(bytes(raw))
+    # the DEFAULT detects corruption (reference RecordReader parity:
+    # verify=True unless explicitly opted out — ADVICE r3 #1)
     with pytest.raises(ValueError):
-        list(tfrecord_iterator(path, verify=True))
-    # unverified iteration still frames correctly
-    assert len(list(tfrecord_iterator(path))) == 1
+        list(tfrecord_iterator(path))
+    # explicit opt-out still frames correctly
+    assert len(list(tfrecord_iterator(path, verify=False))) == 1
     if native.available():
         with pytest.raises(ValueError):
             native.tfrecord_index(path, verify=True)

@@ -196,6 +196,15 @@ def test_extensionless_classic_shard_names(tmp_path):
     # random files must NOT be picked up as shards
     (tmp_path / "train_notes.txt").write_text("x")
     assert len(split_shards(str(tmp_path), "train")) == 2
+    # prefix-extending names must not sweep in either: 'train' is only
+    # a match followed by a delimiter or the extension (ADVICE r3 #4)
+    with TFRecordWriter(str(tmp_path / "trainer_debug.tfrecord")) as w:
+        w.write(b"not-a-shard")
+    assert len(split_shards(str(tmp_path), "train")) == 2
+    # delimiter'd variants of the split DO count
+    with TFRecordWriter(str(tmp_path / "train_old.tfrecord")) as w:
+        w.write(b"x")
+    assert len(split_shards(str(tmp_path), "train")) == 3
 
 
 def test_label_offset_applied_consistently(tfrec_dir):

@@ -206,6 +206,38 @@ def test_overlapping_map_entries_apply_independently(tmp_path):
     np.testing.assert_array_equal(np.asarray(warmed["y"]), [2.0, 2.0])
 
 
+def test_typoed_map_scope_is_loud(tmp_path):
+    """An assignment-map entry whose checkpoint scope resolves ZERO keys
+    warns under the default partial-restore contract and hard-errors
+    under require_all — a typo'd prefix must not silently train the
+    mapped paths from random init (ADVICE r3 #5)."""
+    import logging
+    _, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
+    params = {"x": jnp.zeros((2,))}
+    with pytest.raises(ValueError, match="matches no checkpoint key"):
+        warm_start(params, ckpt_dir, assignment_map={"encodre/": ""},
+                   require_all=True)
+    # the dtx logger doesn't propagate to root (caplog can't see it):
+    # capture via a handler on the named logger directly
+    records = []
+
+    class _Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    lg = logging.getLogger("dtx.warm_start")
+    h = _Grab()
+    lg.addHandler(h)
+    try:
+        _, report = warm_start(params, ckpt_dir,
+                               assignment_map={"encodre/": ""})
+    finally:
+        lg.removeHandler(h)
+    assert report.fresh == ["x"]
+    assert any("matches no checkpoint key" in r.getMessage()
+               for r in records)
+
+
 def test_missing_step_clean_error(tmp_path):
     _, ckpt_dir, _ = _trained_mlp_ckpt(tmp_path)
     with pytest.raises(FileNotFoundError, match="step 99"):
