@@ -76,6 +76,28 @@ def _gather(tree):
             for p in jax.tree_util.tree_leaves(tree)]
 
 
+def _train_leg(model, mesh, shape, seed, batch_size, opt_name="sgd",
+               lr=0.1):
+    """One recorded training leg: bind, shard, run STEPS steps on the
+    deterministic dummy batch; returns (losses, final state). Shared by
+    every leg (and mirrored by the single-process reference in
+    test_two_process_ep_pp.py) so step counts/seeds/recording can never
+    drift between them."""
+    if hasattr(model, "bind_mesh"):
+        model.bind_mesh(mesh)
+    sync = SyncReplicas(model.loss,
+                        make_optimizer(OptimizerConfig(name=opt_name,
+                                                       learning_rate=lr)),
+                        mesh, rules=model.sharding_rules(shape))
+    state = sync.init(model.init, seed=seed)
+    batch = _global_batch(mesh, model.dummy_batch(batch_size))
+    losses = []
+    for _ in range(STEPS):
+        state, m = sync.step(state, batch)
+        losses.append(float(jax.device_get(m["loss"])))
+    return losses, state
+
+
 def _axis_crosses_hosts(mesh, axis: str) -> bool:
     """True iff some fiber along ``axis`` contains devices of BOTH
     processes (i.e. the collective over ``axis`` crosses the host
@@ -111,16 +133,8 @@ def main() -> int:
 
     cfg = MoeBertConfig.tiny()
     cfg.dropout = 0.0
-    model = MoeBert(cfg)
-    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1))
-    sync = SyncReplicas(model.loss, tx, mesh_ep,
-                        rules=model.sharding_rules(shape_ep))
-    state = sync.init(model.init, seed=11)
-    batch = _global_batch(mesh_ep, model.dummy_batch(8))
-    ep_losses = []
-    for _ in range(STEPS):
-        state, m = sync.step(state, batch)
-        ep_losses.append(float(jax.device_get(m["loss"])))
+    ep_losses, state = _train_leg(MoeBert(cfg), mesh_ep, shape_ep,
+                                  seed=11, batch_size=8)
     out["ep_losses"] = np.asarray(ep_losses)
     for i, a in enumerate(_gather(state.params)):
         out[f"ep_p{i}"] = a
@@ -166,25 +180,37 @@ def main() -> int:
     assert _axis_crosses_hosts(mesh_pp, "pipe"), \
         "PP leg must place the pipe axis across both hosts"
 
-    pmodel = get_model("pipe_bert_tiny", TrainConfig(model="pipe_bert_tiny"))
-    pmodel.bind_mesh(mesh_pp)
-    ptx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1))
-    psync = SyncReplicas(pmodel.loss, ptx, mesh_pp,
-                         rules=pmodel.sharding_rules(shape_pp))
-    pstate = psync.init(pmodel.init, seed=12)
-    pbatch = _global_batch(mesh_pp, pmodel.dummy_batch(16))
-    pp_losses = []
-    for _ in range(STEPS):
-        pstate, m = psync.step(pstate, pbatch)
-        pp_losses.append(float(jax.device_get(m["loss"])))
+    pp_losses, pstate = _train_leg(
+        get_model("pipe_bert_tiny", TrainConfig(model="pipe_bert_tiny")),
+        mesh_pp, shape_pp, seed=12, batch_size=16)
     out["pp_losses"] = np.asarray(pp_losses)
     for i, a in enumerate(_gather(pstate.params)):
         out[f"pp_p{i}"] = a
     rt.barrier("pp-ok")
 
+    # --- PP x TP with the TP collectives across the host boundary -----
+    # mesh[d, m, p] = devices[m*4 + d*2 + p]: the model axis pairs one
+    # device per process, so the Megatron-SP all_gather/psum_scatter
+    # inside every layer cross hosts; pipe stays intra-host here (the
+    # previous leg already proved cross-host ppermute)
+    perm_tp = devs.reshape(2, 2, 2).transpose(1, 0, 2).reshape(-1)
+    shape_tp = MeshShape(data=2, model=2, pipe=2)
+    mesh_tp = build_mesh(shape_tp, devices=list(perm_tp))
+    assert _axis_crosses_hosts(mesh_tp, "model"), \
+        "PPxTP leg must place the model axis across both hosts"
+
+    tp_losses, tstate = _train_leg(
+        get_model("pipe_bert_tiny", TrainConfig(model="pipe_bert_tiny")),
+        mesh_tp, shape_tp, seed=13, batch_size=16)
+    out["pptp_losses"] = np.asarray(tp_losses)
+    for i, a in enumerate(_gather(tstate.params)):
+        out[f"pptp_p{i}"] = a
+    rt.barrier("pptp-ok")
+
     np.savez(os.path.join(outdir, f"ep_pp_proc{pid}.npz"), **out)
     rt.barrier("done")
-    print(f"proc {pid}: ep/pp ok, ep={ep_losses}, pp={pp_losses}")
+    print(f"proc {pid}: ep/pp/pptp ok, ep={ep_losses}, pp={pp_losses}, "
+          f"pptp={tp_losses}")
     return 0
 
 

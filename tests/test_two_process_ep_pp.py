@@ -6,8 +6,10 @@ checkpointing across two processes; this module boots the same kind of
 2-process (4+4 virtual CPU devices) cluster with PERMUTED device meshes so
 that the ``expert`` and ``pipe`` axes span the host boundary, making
 
-- ``lax.all_to_all`` (MoE token exchange) and
-- ``lax.ppermute``  (GPipe stage hops, plus all_gather/psum_scatter)
+- ``lax.all_to_all`` (MoE token exchange),
+- ``lax.ppermute``  (GPipe stage hops), and
+- ``lax.all_gather`` / ``lax.psum_scatter`` (Megatron-SP tensor
+  parallelism inside PP×TP, with the ``model`` axis spanning hosts)
 
 cross hosts in CI. The workers assert in-process that the axes really
 cross (``_axis_crosses_hosts``) and that the hand-written all_to_all EP
@@ -100,6 +102,25 @@ def _single_process_reference():
     ref["pp_params"] = [np.asarray(p) for p in
                         jax.tree_util.tree_leaves(
                             jax.device_get(pstate.params))]
+
+    mesh = local_mesh(8, {"data": 2, "model": 2, "pipe": 2})
+    tmodel = get_model("pipe_bert_tiny", TrainConfig(model="pipe_bert_tiny"))
+    tmodel.bind_mesh(mesh)
+    tsync = SyncReplicas(tmodel.loss,
+                         make_optimizer(OptimizerConfig(
+                             name="sgd", learning_rate=0.1)),
+                         mesh, rules=tmodel.sharding_rules(
+                             MeshShape(data=2, model=2, pipe=2)))
+    tstate = tsync.init(tmodel.init, seed=13)
+    tbatch = tsync.shard_batch(tmodel.dummy_batch(16))
+    tlosses = []
+    for _ in range(2):
+        tstate, m = tsync.step(tstate, tbatch)
+        tlosses.append(float(jax.device_get(m["loss"])))
+    ref["pptp_losses"] = np.asarray(tlosses)
+    ref["pptp_params"] = [np.asarray(p) for p in
+                          jax.tree_util.tree_leaves(
+                              jax.device_get(tstate.params))]
     return ref
 
 
@@ -122,3 +143,10 @@ def test_cross_host_matches_single_process(ep_pp_result):
     for i, want in enumerate(ref["pp_params"]):
         np.testing.assert_allclose(z0[f"pp_p{i}"], want, rtol=1e-5,
                                    atol=1e-6, err_msg=f"pp leaf {i}")
+    # PP x TP with cross-host TP collectives: tolerance matches the
+    # single-process PP x TP parity bar (TP splits contractions)
+    np.testing.assert_allclose(z0["pptp_losses"], ref["pptp_losses"],
+                               rtol=1e-5, atol=1e-6)
+    for i, want in enumerate(ref["pptp_params"]):
+        np.testing.assert_allclose(z0[f"pptp_p{i}"], want, rtol=1e-4,
+                                   atol=1e-5, err_msg=f"pptp leaf {i}")
