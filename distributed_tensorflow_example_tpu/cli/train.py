@@ -40,7 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", default="mlp",
                    help="mlp | pipe_mlp | lenet | resnet20 | resnet50 | "
                         "bert | bert_large | bert_tiny | moe_bert | "
-                        "moe_bert_tiny | pipe_bert | pipe_bert_tiny")
+                        "moe_bert_tiny | pipe_bert | pipe_bert_tiny | "
+                        "gpt | gpt_tiny")
     p.add_argument("--dataset", default=None,
                    help="default: the model's canonical dataset")
     p.add_argument("--data_dir", default=None,
@@ -468,6 +469,17 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
         from ..data.imagenet import get_imagenet
         d = get_imagenet(cfg.data.data_dir, cfg.data.synthetic,
                          max_per_class=cfg.data.max_per_class)
+    elif name in ("gpt", "gpt_tiny"):
+        from ..data.bert_data import get_lm_data
+        gcfg = getattr(model, "cfg", None)
+        vocab = gcfg.vocab_size if gcfg else cfg.data.vocab_size
+        if gcfg and cfg.data.seq_len > gcfg.max_len:
+            raise SystemExit(
+                f"--seq_len {cfg.data.seq_len} exceeds the model's "
+                f"max_len {gcfg.max_len}")
+        return get_lm_data(cfg.data.data_dir, vocab_size=vocab,
+                           seq_len=cfg.data.seq_len,
+                           synthetic=cfg.data.synthetic)
     elif name in ("bert", "bert_large", "bert_tiny",
                   "moe_bert", "moe_bert_tiny",
                   "pipe_bert", "pipe_bert_tiny"):

@@ -145,29 +145,57 @@ def load_tokenized(data_dir: str) -> tuple[np.ndarray, np.ndarray]:
         f"{data_dir!r}")
 
 
-def get_bert_data(data_dir: str | None, *, vocab_size: int = 30522,
-                  seq_len: int = 128, max_predictions: int = 20,
-                  mask_prob: float = 0.15, synthetic: bool = False,
-                  num_train: int = 2048, num_test: int = 256,
-                  seed: int = 0) -> tuple[dict, dict]:
-    """Returns (train_arrays, eval_arrays) in the framework batch layout."""
+def _load_seqs(data_dir, seq_len, vocab_size, synthetic,
+               num_train, num_test, seed):
+    """Shared token-source resolution for the MLM and causal-LM
+    pipelines: pre-tokenized files (truncated to seq_len with a warning
+    — the file's full length would be a quadratically costlier workload
+    than asked for) or the synthetic corpus."""
     if data_dir and not synthetic:
         train_seqs, test_seqs = load_tokenized(data_dir)
         if train_seqs.shape[1] > seq_len:
-            # honor the requested sequence length on real data too — running
-            # at the file's full length would be a silently different
-            # (quadratically costlier) workload than the user asked for
             import logging
             logging.getLogger("dtx.data").warning(
                 "truncating pre-tokenized sequences from %d to seq_len=%d",
                 train_seqs.shape[1], seq_len)
             train_seqs = train_seqs[:, :seq_len]
             test_seqs = test_seqs[:, :seq_len]
-    else:
-        train_seqs = synthetic_corpus(num_train, seq_len, vocab_size, seed)
-        test_seqs = synthetic_corpus(num_test, seq_len, vocab_size,
-                                     seed + 1)
+        return train_seqs, test_seqs
+    return (synthetic_corpus(num_train, seq_len, vocab_size, seed),
+            synthetic_corpus(num_test, seq_len, vocab_size, seed + 1))
+
+
+def get_bert_data(data_dir: str | None, *, vocab_size: int = 30522,
+                  seq_len: int = 128, max_predictions: int = 20,
+                  mask_prob: float = 0.15, synthetic: bool = False,
+                  num_train: int = 2048, num_test: int = 256,
+                  seed: int = 0) -> tuple[dict, dict]:
+    """Returns (train_arrays, eval_arrays) in the framework batch layout."""
+    train_seqs, test_seqs = _load_seqs(data_dir, seq_len, vocab_size,
+                                       synthetic, num_train, num_test,
+                                       seed)
     kw = dict(vocab_size=vocab_size, max_predictions=max_predictions,
               mask_prob=mask_prob)
     return (apply_mlm_masking(train_seqs, seed=seed + 2, **kw),
             apply_mlm_masking(test_seqs, seed=seed + 3, **kw))
+
+
+def get_lm_data(data_dir: "str | None", *, vocab_size: int = 30522,
+                seq_len: int = 128, synthetic: bool = False,
+                num_train: int = 2048, num_test: int = 256,
+                seed: int = 0) -> "tuple[dict, dict]":
+    """Causal-LM batches: the same token sources as the MLM pipeline
+    (pre-tokenized ``.npy`` files or the synthetic corpus) WITHOUT
+    masking — the model trains on next-token prediction, so the batch is
+    just ``{input_ids, attention_mask}``. PAD positions (token 0, the
+    same convention the MLM pipeline uses) are masked out: they carry no
+    loss and are invisible as attention keys."""
+    train_seqs, test_seqs = _load_seqs(data_dir, seq_len, vocab_size,
+                                       synthetic, num_train, num_test,
+                                       seed)
+
+    def pack(seqs):
+        return {"input_ids": seqs.astype(np.int32),
+                "attention_mask": (seqs != PAD).astype(np.int32)}
+
+    return pack(train_seqs), pack(test_seqs)

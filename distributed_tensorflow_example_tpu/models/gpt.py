@@ -1,0 +1,402 @@
+"""GPT-style causal language model + KV-cache autoregressive decoding.
+
+No decoder-only model exists in the reference (MLP/CNN era — SURVEY.md
+§2.5/§5.7); this family exists because a framework claiming transformer
+coverage needs the *causal* half of the design space: causal attention
+masks, next-token training, and the TPU-native autoregressive inference
+pattern (static-shape KV cache advanced by ``lax.scan`` +
+``dynamic_update_slice`` — the decode loop that cannot be expressed as
+"just call the trainer again").
+
+Architecture (GPT-2 layout): learned token + position embeddings, pre-LN
+blocks (``h += attn(ln1(h)); h += ffn(ln2(h))``), final layernorm, LM
+head weight-tied to the token embedding. Causal masking rides the shared
+:func:`~..ops.attention.multi_head_attention` ``causal=True`` path (xla
+and flash impls both support it).
+
+TPU-first notes:
+
+- bf16 matmuls / f32 softmax+LN, static shapes (same recipe as Bert).
+- Megatron TP via ``sharding_rules`` (QKV/FFN-in column-split, O/FFN-out
+  row-split, vocab-sharded tied embedding) — the same rule shapes as
+  Bert, so TP/fsdp/data compose identically.
+- ``generate``: prefill runs ONE full causal forward over the prompt
+  (MXU-dense), then the decode loop is a single compiled ``lax.scan``
+  whose carry is the static-shape [B, T, H, D] per-layer KV cache —
+  no per-token retrace, no dynamic shapes, one dispatch for the whole
+  generation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..config import TrainConfig
+from ..ops import losses, nn
+from ..ops.attention import multi_head_attention
+from ..parallel.mesh import AxisNames
+from ..parallel.sharding import ShardingRules
+from .base import cast_floating, register_model, resolve_dtype
+from .bert import REMAT_POLICIES
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 30522       # framework default vocab (BERT wordpiece)
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    intermediate: int = 3072
+    max_len: int = 1024
+    dropout: float = 0.1
+
+    @classmethod
+    def small(cls) -> "GPTConfig":
+        """GPT-2-small shape (124M at its native 50k vocab)."""
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "GPTConfig":
+        return cls(vocab_size=1000, hidden=128, layers=2, heads=4,
+                   intermediate=256, max_len=128)
+
+
+class GPT:
+    name = "gpt"
+
+    def __init__(self, cfg: GPTConfig, dtype=jnp.float32,
+                 attention_impl: str = "xla", attention_fn=None,
+                 param_dtype=jnp.float32, remat: str = "none"):
+        assert cfg.hidden % cfg.heads == 0
+        if remat != "none" and remat not in REMAT_POLICIES:
+            raise ValueError(f"remat must be one of "
+                             f"{['none', *REMAT_POLICIES]}, got {remat!r}")
+        if attention_fn is not None:
+            raise ValueError(
+                "ring attention is not wired for the causal family yet "
+                "(needs causal block masking across the seq shards)")
+        self.cfg = cfg
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+        self.attention_impl = attention_impl
+        self.remat = remat
+        self.head_dim = cfg.hidden // cfg.heads
+
+    def _maybe_remat(self, fn):
+        if self.remat == "none":
+            return fn
+        return jax.checkpoint(fn, policy=REMAT_POLICIES[self.remat])
+
+    # ------------------------------------------------------------------
+    def init(self, rng: jax.Array):
+        c = self.cfg
+        keys = iter(jax.random.split(rng, 2 + c.layers * 6))
+        params: dict = {
+            "wte": nn.embedding_init(next(keys), c.vocab_size, c.hidden),
+            "wpe": nn.embedding_init(next(keys), c.max_len, c.hidden),
+        }
+        for i in range(c.layers):
+            params[f"layer_{i}"] = {
+                "ln1": nn.layernorm_init(c.hidden),
+                "attn": {
+                    "q": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                    "k": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                    "v": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                    "o": nn.dense_init(next(keys), c.hidden, c.hidden,
+                                       init="glorot"),
+                },
+                "ln2": nn.layernorm_init(c.hidden),
+                "ffn": {
+                    "in": nn.dense_init(next(keys), c.hidden,
+                                        c.intermediate, init="glorot"),
+                    "out": nn.dense_init(next(keys), c.intermediate,
+                                         c.hidden, init="glorot"),
+                },
+            }
+        params["ln_f"] = nn.layernorm_init(c.hidden)
+        return cast_floating(params, self.param_dtype)
+
+    # ------------------------------------------------------------------
+    def _qkv(self, ap, h):
+        b, s, _ = h.shape
+
+        def split(x):
+            return x.reshape(b, s, self.cfg.heads, self.head_dim)
+
+        return (split(nn.dense(ap["q"], h, dtype=self.dtype)),
+                split(nn.dense(ap["k"], h, dtype=self.dtype)),
+                split(nn.dense(ap["v"], h, dtype=self.dtype)))
+
+    def _ffn(self, lp, x):
+        f = nn.dense(lp["ffn"]["in"], x, dtype=self.dtype)
+        f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+        return nn.dense(lp["ffn"]["out"], f, dtype=self.dtype)
+
+    def _layer(self, lp, h, mask, lrng, *, train: bool,
+               use_dropout: bool, return_kv: bool = False):
+        """Pre-LN decoder block (full-sequence causal path). ONE body for
+        training and prefill: ``return_kv`` additionally yields this
+        layer's (k, v) so the decode cache is filled by the exact same
+        computation the oracle runs — an architecture tweak here cannot
+        diverge the cached path."""
+        c = self.cfg
+        b, s, _ = h.shape
+        q, k, v = self._qkv(lp["attn"], nn.layernorm(lp["ln1"], h))
+        ctx = multi_head_attention(
+            q, k, v, mask=mask[:, None, None, :], causal=True,
+            impl=self.attention_impl)
+        a = nn.dense(lp["attn"]["o"], ctx.reshape(b, s, c.hidden),
+                     dtype=self.dtype)
+        if use_dropout:
+            a = nn.dropout(jax.random.fold_in(lrng, 1), a, c.dropout,
+                           train=True)
+        h = h + a.astype(h.dtype)
+        f = self._ffn(lp, nn.layernorm(lp["ln2"], h))
+        if use_dropout:
+            f = nn.dropout(jax.random.fold_in(lrng, 2), f, c.dropout,
+                           train=True)
+        h = h + f.astype(h.dtype)
+        return (h, (k, v)) if return_kv else h
+
+    def _embed(self, params, ids, pos_ids, rng, train):
+        c = self.cfg
+        h = (nn.embedding(params["wte"], ids)
+             + nn.embedding(params["wpe"], pos_ids))
+        h = h.astype(self.dtype)
+        use_dropout = train and c.dropout > 0 and rng is not None
+        if use_dropout:
+            h = nn.dropout(jax.random.fold_in(rng, 1000), h, c.dropout,
+                           train=True)
+        return h, use_dropout
+
+    def encode(self, params, batch, rng=None, train: bool = False):
+        c = self.cfg
+        ids = batch["input_ids"]
+        _, s = ids.shape
+        mask = batch.get("attention_mask", jnp.ones_like(ids))
+        h, use_dropout = self._embed(
+            params, ids, jnp.arange(s, dtype=jnp.int32)[None], rng, train)
+        layer = self._maybe_remat(
+            functools.partial(self._layer, train=train,
+                              use_dropout=use_dropout))
+        for i in range(c.layers):
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            h = layer(params[f"layer_{i}"], h, mask, lrng)
+        return nn.layernorm(params["ln_f"], h)
+
+    def lm_logits(self, params, h):
+        """Weight-tied LM head: [B,S,hid] -> [B,S,V] f32 logits."""
+        table = params["wte"]["table"]
+        logits = jnp.einsum("bsh,vh->bsv", h.astype(self.dtype),
+                            table.astype(self.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits
+
+    def apply(self, params, extras, batch, rng=None, train: bool = False):
+        return self.lm_logits(
+            params, self.encode(params, batch, rng, train)), extras
+
+    # ------------------------------------------------------------------
+    def loss(self, params, extras, batch, rng):
+        logits, new_extras = self.apply(params, extras, batch, rng,
+                                        train=True)
+        # next-token prediction: position t predicts token t+1; padding
+        # (attention_mask == 0) carries no loss
+        targets = batch["input_ids"][:, 1:]
+        lg = logits[:, :-1]
+        mask = batch.get("attention_mask",
+                         jnp.ones_like(batch["input_ids"]))
+        w = mask[:, 1:].astype(jnp.float32)
+        loss = losses.softmax_xent_int_labels(lg, targets, where=w)
+        pred = jnp.argmax(lg, axis=-1)
+        acc = (jnp.sum((pred == targets) * w)
+               / jnp.maximum(jnp.sum(w), 1.0))
+        return loss, ({"token_accuracy": acc}, new_extras)
+
+    def eval_metrics(self, params, extras, batch) -> dict:
+        logits, _ = self.apply(params, extras, batch, train=False)
+        targets = batch["input_ids"][:, 1:]
+        lg = logits[:, :-1]
+        mask = batch.get("attention_mask",
+                         jnp.ones_like(batch["input_ids"]))
+        w = mask[:, 1:].astype(jnp.float32)
+        valid = batch.get("__valid__")
+        if valid is not None:
+            w = w * valid.astype(jnp.float32)[:, None]
+        pred = jnp.argmax(lg, axis=-1)
+        loss = losses.softmax_xent_int_labels(lg, targets, where=w)
+        return {
+            "loss": loss,
+            # the classic LM headline number; exp of the masked mean xent
+            "perplexity": jnp.exp(loss),
+            "token_accuracy": (jnp.sum((pred == targets) * w)
+                               / jnp.maximum(jnp.sum(w), 1.0)),
+        }
+
+    # ------------------------------------------------------------------
+    # autoregressive decoding (static-shape KV cache, one compiled scan)
+    # ------------------------------------------------------------------
+    def _prefill(self, params, ids, total_len: int):
+        """Full causal forward over the dense prompt, additionally
+        returning per-layer K/V padded to ``total_len`` slots. Returns
+        (last_hidden [B,hid], caches {layer_i: {k, v}: [B,T,H,D]})."""
+        c = self.cfg
+        _, s = ids.shape
+        mask = jnp.ones_like(ids)
+        h, _ = self._embed(params, ids,
+                           jnp.arange(s, dtype=jnp.int32)[None],
+                           rng=None, train=False)
+        caches = {}
+        pad = [(0, 0), (0, total_len - s), (0, 0), (0, 0)]
+        for i in range(c.layers):
+            h, (k, v) = self._layer(params[f"layer_{i}"], h, mask, None,
+                                    train=False, use_dropout=False,
+                                    return_kv=True)
+            caches[f"layer_{i}"] = {"k": jnp.pad(k, pad),
+                                    "v": jnp.pad(v, pad)}
+        h = nn.layernorm(params["ln_f"], h)
+        return h[:, -1], caches
+
+    def _decode_step(self, params, caches, tok, pos):
+        """One-token forward against the cache. ``tok`` [B] int32,
+        ``pos`` scalar (the position tok sits at). Returns (logits [B,V],
+        updated caches)."""
+        c = self.cfg
+        b = tok.shape[0]
+        total = jax.tree_util.tree_leaves(caches)[0].shape[1]
+        h, _ = self._embed(params, tok[:, None], pos[None, None],
+                           rng=None, train=False)
+        kmask = (jnp.arange(total, dtype=jnp.int32) <= pos)
+        kmask = jnp.broadcast_to(kmask, (b, total))
+        new_caches = {}
+        for i in range(c.layers):
+            lp = params[f"layer_{i}"]
+            cache = caches[f"layer_{i}"]
+            q, k, v = self._qkv(lp["attn"], nn.layernorm(lp["ln1"], h))
+            ck = lax.dynamic_update_slice_in_dim(cache["k"],
+                                                 k.astype(cache["k"].dtype),
+                                                 pos, axis=1)
+            cv = lax.dynamic_update_slice_in_dim(cache["v"],
+                                                 v.astype(cache["v"].dtype),
+                                                 pos, axis=1)
+            new_caches[f"layer_{i}"] = {"k": ck, "v": cv}
+            ctx = multi_head_attention(
+                q, ck, cv, mask=kmask[:, None, None, :],
+                impl="xla")     # 1-query attention: tiles never pay off
+            a = nn.dense(lp["attn"]["o"], ctx.reshape(b, 1, c.hidden),
+                         dtype=self.dtype)
+            h = h + a.astype(h.dtype)
+            f = self._ffn(lp, nn.layernorm(lp["ln2"], h))
+            h = h + f.astype(h.dtype)
+        h = nn.layernorm(params["ln_f"], h)
+        return self.lm_logits(params, h)[:, 0], new_caches
+
+    def generate(self, params, input_ids, max_new_tokens: int, *,
+                 temperature: float = 0.0, rng: jax.Array | None = None):
+        """Greedy (``temperature=0``) or sampled autoregressive
+        generation from a DENSE prompt (no padding — standard decode
+        entry). Returns [B, max_new_tokens] int32. Jit-compatible:
+        ``jax.jit(partial(model.generate, max_new_tokens=K))``.
+        """
+        c = self.cfg
+        b, s0 = input_ids.shape
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, got "
+                             f"{max_new_tokens}")
+        if max_new_tokens == 0:
+            return jnp.zeros((b, 0), jnp.int32)
+        total = s0 + max_new_tokens
+        if total > c.max_len:
+            raise ValueError(
+                f"prompt {s0} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_len {c.max_len}")
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs rng")
+
+        last_h, caches = self._prefill(params, input_ids, total)
+        first_logits = self.lm_logits(params, last_h[:, None])[:, 0]
+
+        def pick(logits, step_rng):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                step_rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+        tok0 = pick(first_logits,
+                    jax.random.fold_in(rng, 0) if rng is not None else None)
+
+        def body(carry, step):
+            caches, tok, pos = carry
+            logits, caches = self._decode_step(params, caches, tok, pos)
+            nxt = pick(logits,
+                       jax.random.fold_in(rng, step + 1)
+                       if rng is not None else None)
+            return (caches, nxt, pos + 1), tok
+
+        (_, last_tok, _), toks = lax.scan(
+            body, (caches, tok0, jnp.int32(s0)),
+            jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+        # toks carries tokens 0..max_new-2 (each body emits its INPUT
+        # token); the final pick is appended explicitly
+        out = jnp.concatenate([toks.transpose(1, 0), last_tok[:, None]],
+                              axis=1)
+        return out
+
+    # ------------------------------------------------------------------
+    def sharding_rules(self, mesh_shape) -> ShardingRules:
+        """Megatron TP, same shapes as Bert; vocab-sharded tied head."""
+        M = AxisNames.MODEL
+        fsdp = getattr(mesh_shape, "fsdp", 1) if mesh_shape else 1
+        tp = getattr(mesh_shape, "model", 1) if mesh_shape else 1
+        if tp <= 1:
+            return ShardingRules(fsdp_axis_size=fsdp)
+        return ShardingRules(rules=[
+            (r"attn/(q|k|v)/kernel", P(None, M)),
+            (r"attn/(q|k|v)/bias", P(M)),
+            (r"attn/o/kernel", P(M, None)),
+            (r"ffn/in/kernel", P(None, M)),
+            (r"ffn/in/bias", P(M)),
+            (r"ffn/out/kernel", P(M, None)),
+            (r"\bwte/table", P(M, None)),       # vocab-sharded tied head
+        ], fsdp_axis_size=fsdp)
+
+    def dummy_batch(self, batch_size: int):
+        c = self.cfg
+        rs = np.random.RandomState(0)
+        s = min(128, c.max_len)
+        return {
+            "input_ids": rs.randint(0, c.vocab_size, (batch_size, s),
+                                    dtype=np.int32),
+            "attention_mask": np.ones((batch_size, s), np.int32),
+        }
+
+
+def _make(config: TrainConfig, cfg: GPTConfig, *,
+          config_vocab: bool = True) -> GPT:
+    if config_vocab:
+        cfg.vocab_size = config.data.vocab_size
+    cfg.max_len = max(cfg.max_len, config.data.seq_len)
+    return GPT(cfg, dtype=resolve_dtype(config.dtype),
+               attention_impl=config.attention_impl,
+               param_dtype=resolve_dtype(config.param_dtype),
+               remat=config.remat)
+
+
+@register_model("gpt")
+def _make_gpt(config: TrainConfig) -> GPT:
+    return _make(config, GPTConfig.small())
+
+
+@register_model("gpt_tiny")
+def _make_gpt_tiny(config: TrainConfig) -> GPT:
+    return _make(config, GPTConfig.tiny(), config_vocab=False)
