@@ -179,3 +179,18 @@ def test_moe_export_falls_back_to_static_batch(tmp_path):
         np.asarray(sv(feats)),
         np.asarray(m.apply(params, extras, feats, train=False)[0]),
         rtol=1e-5, atol=1e-5)
+
+
+def test_gpt_exports_and_serves(tmp_path):
+    """The causal-LM family rides the generic export path: logits from
+    the StableHLO artifact match the live model."""
+    from distributed_tensorflow_example_tpu.models import get_model
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    params = m.init(jax.random.key(0))
+    d = str(tmp_path / "gpt")
+    export_model(m, params, {}, d, platforms=("cpu",))
+    sv = load_servable(d)
+    feats = serving_signature(m.dummy_batch(2))
+    out = np.asarray(sv(feats))
+    want = np.asarray(m.apply(params, {}, feats, train=False)[0])
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
