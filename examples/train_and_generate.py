@@ -1,0 +1,112 @@
+"""Train a causal LM and generate from it — the decoder workflow.
+
+The reference era had no decoder-only models; this example shows the
+framework's causal half end to end, on synthetic token data::
+
+    python examples/train_and_generate.py --workdir /tmp/lm
+
+Steps (each maps to one framework feature):
+
+1. train    — a short ``gpt_tiny`` next-token run over the sync
+   data-parallel mesh, checkpointed (``Trainer`` + ``CheckpointManager``;
+   eval reports loss / perplexity / token accuracy).
+2. reload   — the checkpoint restored into a fresh process the same way
+   any training run resumes (``restore_or_init``).
+3. generate — greedy AND temperature-sampled continuations from a
+   prompt via the KV-cache decode path (``GPT.generate``: one full
+   prefill forward, then the whole generation as a single compiled
+   ``lax.scan`` over a static-shape cache).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--workdir", default="/tmp/dtx_lm")
+    ap.add_argument("--train_steps", type=int, default=60)
+    ap.add_argument("--prompt_len", type=int, default=8)
+    ap.add_argument("--new_tokens", type=int, default=16)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual 8-device CPU mesh")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+        CheckpointManager, restore_or_init)
+    from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                           DataConfig,
+                                                           MeshShape,
+                                                           OptimizerConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.data.bert_data import get_lm_data
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+        SyncReplicas)
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    ckpt_dir = os.path.join(args.workdir, "ckpt")
+
+    # 1. train -----------------------------------------------------------
+    cfg = TrainConfig(
+        model="gpt_tiny", train_steps=args.train_steps,
+        mesh=MeshShape(data=-1),       # all devices on the data axis
+        data=DataConfig(batch_size=32, seq_len=64),
+        optimizer=OptimizerConfig(name="adamw", learning_rate=3e-3),
+        checkpoint=CheckpointConfig(directory=ckpt_dir,
+                                    save_steps=args.train_steps),
+        eval_every_steps=args.train_steps, seed=0)
+    model = get_model("gpt_tiny", cfg)
+    train_arrays, eval_arrays = get_lm_data(
+        None, vocab_size=model.cfg.vocab_size, seq_len=64, synthetic=True)
+    with Trainer(model, cfg, train_arrays, eval_arrays,
+                 mesh=build_mesh(cfg.mesh)) as trainer:
+        _, summary = trainer.train()
+    print(f"trained to step {summary['final_step']}: "
+          f"perplexity {summary['eval']['perplexity']:.1f}, "
+          f"token accuracy {summary['eval']['token_accuracy']:.3f}")
+
+    # 2. reload ----------------------------------------------------------
+    sync = SyncReplicas(model.loss, make_optimizer(cfg.optimizer),
+                        build_mesh(cfg.mesh))
+    state, restored = restore_or_init(
+        CheckpointManager(ckpt_dir),
+        lambda: sync.init(model.init, seed=cfg.seed))
+    assert restored, "checkpoint must be found"
+
+    # 3. generate --------------------------------------------------------
+    # prompt: the start of a held-out eval sequence; the synthetic corpus
+    # has bigram structure, so a trained model visibly continues patterns
+    prompt = jnp.asarray(
+        eval_arrays["input_ids"][:2, :args.prompt_len])
+    greedy = jax.jit(
+        lambda p, i: model.generate(p, i, args.new_tokens))(
+        state.params, prompt)
+    sampled = model.generate(state.params, prompt, args.new_tokens,
+                             temperature=0.8, rng=jax.random.key(0))
+    for b in range(prompt.shape[0]):
+        print(f"prompt : {np.asarray(prompt)[b].tolist()}")
+        print(f"greedy : {np.asarray(greedy)[b].tolist()}")
+        print(f"sampled: {np.asarray(sampled)[b].tolist()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

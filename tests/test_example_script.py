@@ -13,6 +13,18 @@ _EXAMPLE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples", "mnist_distributed.py")
 
 
+def _load_example(name: str):
+    """Import an examples/ script as a module (shared loader — every
+    example test uses the same spec/exec dance)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(_EXAMPLE), name)
+    spec = importlib.util.spec_from_file_location(
+        name.removesuffix(".py"), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _run(args, timeout=300):
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -51,18 +63,21 @@ def test_ps_branch_exits_zero_with_notice():
 def test_finetune_export_lifecycle(tmp_path):
     """examples/finetune_export.py: pretrain -> warm-start fine-tune
     with EMA -> export EMA weights -> serve from the artifact alone."""
-    import importlib.util
-    import os
-    spec = importlib.util.spec_from_file_location(
-        "finetune_export",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "examples",
-            "finetune_export.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load_example("finetune_export.py")
     out = mod.run(str(tmp_path), pretrain_steps=40, finetune_steps=30)
     assert out["pretrain_eval"]["accuracy"] > 0.9
     assert out["finetune_eval"]["accuracy"] > 0.9
     assert out["servable_accuracy_16"] > 0.9
     assert os.path.exists(os.path.join(out["export_dir"],
                                        "model.stablehlo"))
+
+
+def test_train_and_generate_example(tmp_path, capsys):
+    """examples/train_and_generate.py: train gpt_tiny -> restore ->
+    greedy + sampled KV-cache generation."""
+    mod = _load_example("train_and_generate.py")
+    rc = mod.main(["--workdir", str(tmp_path), "--train_steps", "8",
+                   "--new_tokens", "6"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "greedy" in out and "sampled" in out
