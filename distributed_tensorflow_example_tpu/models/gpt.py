@@ -78,14 +78,15 @@ class GPT:
         if remat != "none" and remat not in REMAT_POLICIES:
             raise ValueError(f"remat must be one of "
                              f"{['none', *REMAT_POLICIES]}, got {remat!r}")
-        if attention_fn is not None:
-            raise ValueError(
-                "ring attention is not wired for the causal family yet "
-                "(needs causal block masking across the seq shards)")
         self.cfg = cfg
         self.dtype = dtype
         self.param_dtype = param_dtype
         self.attention_impl = attention_impl
+        # sequence parallelism: pass make_ring_attention(mesh, causal=True)
+        # — the ring schedule's causal block masking (global q/k offsets
+        # per hop) makes the sharded result equal the single-device
+        # causal attention; asserted in tests/test_gpt.py
+        self.attention_fn = attention_fn
         self.remat = remat
         self.head_dim = cfg.hidden // cfg.heads
 
@@ -152,9 +153,12 @@ class GPT:
         c = self.cfg
         b, s, _ = h.shape
         q, k, v = self._qkv(lp["attn"], nn.layernorm(lp["ln1"], h))
-        ctx = multi_head_attention(
-            q, k, v, mask=mask[:, None, None, :], causal=True,
-            impl=self.attention_impl)
+        if self.attention_fn is not None:
+            ctx = self.attention_fn(q, k, v, mask=mask, causal=True)
+        else:
+            ctx = multi_head_attention(
+                q, k, v, mask=mask[:, None, None, :], causal=True,
+                impl=self.attention_impl)
         a = nn.dense(lp["attn"]["o"], ctx.reshape(b, s, c.hidden),
                      dtype=self.dtype)
         if use_dropout:
