@@ -135,6 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_jitter", type=float, default=None,
                    help="MoE models: router input noise amplitude "
                         "U[1-j, 1+j], training only (typ. 0.01)")
+    p.add_argument("--lm_loss_chunk", type=int, default=None,
+                   help="gpt models: sequence-chunked LM loss — at most "
+                        "[B, chunk, vocab] logits resident (the full "
+                        "tensor OOMs long-context/big-batch causal "
+                        "training); must divide seq_len; 0 = full")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="smooth training targets (image classifiers: "
                         "lenet/resnet20/resnet50; the standard ImageNet "
@@ -308,6 +313,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         moe_aux_weight=args.moe_aux_weight,
         moe_router_z_weight=args.moe_router_z_weight,
         moe_jitter=args.moe_jitter,
+        lm_loss_chunk=args.lm_loss_chunk,
         eval_every_steps=args.eval_every_steps,
         early_stop_metric=args.early_stop_metric,
         early_stop_patience=args.early_stop_patience,
@@ -561,6 +567,10 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(
             f"--label_smoothing is wired for the image classifiers "
             f"(lenet/resnet20/resnet50), not model {args.model!r}")
+    if args.lm_loss_chunk is not None and not args.model.startswith("gpt"):
+        raise SystemExit(
+            f"--lm_loss_chunk is a causal-LM knob (gpt/gpt_tiny), not "
+            f"for model {args.model!r}")
     for flag, val in (("--moe_experts", args.moe_experts),
                       ("--moe_top_k", args.moe_top_k),
                       ("--moe_capacity_factor", args.moe_capacity_factor),

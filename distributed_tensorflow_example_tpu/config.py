@@ -226,6 +226,7 @@ class TrainConfig:
     moe_aux_weight: float | None = None  # load-balancing loss weight
     moe_router_z_weight: float | None = None   # ST-MoE router z-loss
     moe_jitter: float | None = None      # router noise U[1-j,1+j] (train)
+    lm_loss_chunk: int | None = None     # gpt: seq-chunked LM loss (0=full)
     eval_every_steps: int = 0        # 0 => eval only at the end
     early_stop_metric: str | None = None  # stop when this eval metric
                                           # stops improving

@@ -56,6 +56,13 @@ class GPTConfig:
     intermediate: int = 3072
     max_len: int = 1024
     dropout: float = 0.1
+    #: LM-loss sequence chunk: the [B, S, vocab] logits tensor is the
+    #: memory wall of causal-LM training (b64 s512 at the 30k vocab is
+    #: ~4 GB of f32 logits — measured OOM on the v5e chip). chunk > 0
+    #: computes logits + xent per seq chunk under jax.checkpoint, so at
+    #: most [B, chunk, vocab] is ever resident (backward recomputes per
+    #: chunk). 0 = single full-logits pass.
+    loss_chunk: int = 0
 
     @classmethod
     def small(cls) -> "GPTConfig":
@@ -211,16 +218,64 @@ class GPT:
             params, self.encode(params, batch, rng, train)), extras
 
     # ------------------------------------------------------------------
+    def _chunked_lm_loss(self, params, h, targets, w, chunk: int):
+        """Sequence-chunked next-token loss: per chunk, compute the
+        [B, chunk, V] logits + xent and DROP them (jax.checkpoint), so
+        the full [B, S, V] tensor never exists in forward or backward.
+        Returns (loss, accuracy) with identical semantics to the full
+        pass (weighted token mean)."""
+        b, s, hid = h.shape
+        n = s // chunk          # caller guarantees divisibility
+        hs = h.reshape(b, n, chunk, hid).transpose(1, 0, 2, 3)
+        ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+        ws = w.reshape(b, n, chunk).transpose(1, 0, 2)
+
+        @jax.checkpoint
+        def body(carry, xs):
+            hh, tt, ww = xs
+            logits = self.lm_logits(params, hh)
+            nll = losses.token_nll(logits, tt) * ww
+            hits = (jnp.argmax(logits, axis=-1) == tt) * ww
+            lsum, hsum, wsum = carry
+            return (lsum + jnp.sum(nll), hsum + jnp.sum(hits),
+                    wsum + jnp.sum(ww)), None
+
+        (lsum, hsum, wsum), _ = lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                   jnp.zeros((), jnp.float32)), (hs, ts, ws))
+        denom = jnp.maximum(wsum, 1.0)
+        return lsum / denom, hsum / denom
+
     def loss(self, params, extras, batch, rng):
-        logits, new_extras = self.apply(params, extras, batch, rng,
-                                        train=True)
         # next-token prediction: position t predicts token t+1; padding
         # (attention_mask == 0) carries no loss
         targets = batch["input_ids"][:, 1:]
-        lg = logits[:, :-1]
         mask = batch.get("attention_mask",
                          jnp.ones_like(batch["input_ids"]))
         w = mask[:, 1:].astype(jnp.float32)
+        chunk = self.cfg.loss_chunk
+        if chunk:
+            ids = batch["input_ids"]
+            S = ids.shape[1]
+            if S % chunk:
+                raise ValueError(
+                    f"loss_chunk={chunk} must divide seq_len={S} "
+                    "(a silent full-logits fallback would OOM exactly "
+                    "the configs the knob exists for)")
+            # chunk over the FULL S positions (powers of two divide):
+            # position S-1 predicts nothing — its target is a dummy with
+            # weight 0
+            h = self.encode(params, batch, rng, train=True)
+            t_full = jnp.concatenate(
+                [targets, jnp.zeros_like(targets[:, :1])], axis=1)
+            w_full = jnp.concatenate(
+                [w, jnp.zeros_like(w[:, :1])], axis=1)
+            loss, acc = self._chunked_lm_loss(params, h, t_full, w_full,
+                                              chunk)
+            return loss, ({"token_accuracy": acc}, extras)
+        logits, new_extras = self.apply(params, extras, batch, rng,
+                                        train=True)
+        lg = logits[:, :-1]
         loss = losses.softmax_xent_int_labels(lg, targets, where=w)
         pred = jnp.argmax(lg, axis=-1)
         acc = (jnp.sum((pred == targets) * w)
@@ -228,23 +283,42 @@ class GPT:
         return loss, ({"token_accuracy": acc}, new_extras)
 
     def eval_metrics(self, params, extras, batch) -> dict:
-        logits, _ = self.apply(params, extras, batch, train=False)
         targets = batch["input_ids"][:, 1:]
-        lg = logits[:, :-1]
         mask = batch.get("attention_mask",
                          jnp.ones_like(batch["input_ids"]))
         w = mask[:, 1:].astype(jnp.float32)
         valid = batch.get("__valid__")
         if valid is not None:
             w = w * valid.astype(jnp.float32)[:, None]
-        pred = jnp.argmax(lg, axis=-1)
-        loss = losses.softmax_xent_int_labels(lg, targets, where=w)
+        chunk = self.cfg.loss_chunk
+        if chunk:
+            # same memory wall as training: the final eval of a chunked
+            # run must not materialize the full [B, S, vocab] tensor the
+            # knob exists to avoid
+            ids = batch["input_ids"]
+            if ids.shape[1] % chunk:
+                raise ValueError(
+                    f"loss_chunk={chunk} must divide seq_len="
+                    f"{ids.shape[1]}")
+            h = self.encode(params, batch, train=False)
+            t_full = jnp.concatenate(
+                [targets, jnp.zeros_like(targets[:, :1])], axis=1)
+            w_full = jnp.concatenate(
+                [w, jnp.zeros_like(w[:, :1])], axis=1)
+            loss, acc = self._chunked_lm_loss(params, h, t_full, w_full,
+                                              chunk)
+        else:
+            logits, _ = self.apply(params, extras, batch, train=False)
+            lg = logits[:, :-1]
+            pred = jnp.argmax(lg, axis=-1)
+            loss = losses.softmax_xent_int_labels(lg, targets, where=w)
+            acc = (jnp.sum((pred == targets) * w)
+                   / jnp.maximum(jnp.sum(w), 1.0))
         return {
             "loss": loss,
             # the classic LM headline number; exp of the masked mean xent
             "perplexity": jnp.exp(loss),
-            "token_accuracy": (jnp.sum((pred == targets) * w)
-                               / jnp.maximum(jnp.sum(w), 1.0)),
+            "token_accuracy": acc,
         }
 
     # ------------------------------------------------------------------
@@ -390,6 +464,11 @@ def _make(config: TrainConfig, cfg: GPTConfig, *,
     if config_vocab:
         cfg.vocab_size = config.data.vocab_size
     cfg.max_len = max(cfg.max_len, config.data.seq_len)
+    if config.lm_loss_chunk is not None:
+        if config.lm_loss_chunk < 0:
+            raise ValueError(
+                f"lm_loss_chunk={config.lm_loss_chunk} must be >= 0")
+        cfg.loss_chunk = config.lm_loss_chunk
     return GPT(cfg, dtype=resolve_dtype(config.dtype),
                attention_impl=config.attention_impl,
                param_dtype=resolve_dtype(config.param_dtype),

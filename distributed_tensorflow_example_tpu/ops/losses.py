@@ -30,6 +30,20 @@ def softmax_xent(logits: jax.Array, onehot: jax.Array,
     return -_masked_mean(ll, where)
 
 
+def token_nll(logits: jax.Array, labels: jax.Array, *,
+              label_smoothing: float = 0.0) -> jax.Array:
+    """Per-token negative log-likelihood (gather form, no one-hots) —
+    the shared numerics core of :func:`softmax_xent_int_labels` and the
+    chunked LM loss (models/gpt.py), so the two can never diverge."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1).squeeze(-1)
+    if label_smoothing:
+        eps = label_smoothing
+        picked = (1.0 - eps) * picked + eps * jnp.mean(logits, axis=-1)
+    return logz - picked
+
+
 def softmax_xent_int_labels(logits: jax.Array, labels: jax.Array,
                             *, where=None,
                             label_smoothing: float = 0.0) -> jax.Array:
@@ -44,14 +58,8 @@ def softmax_xent_int_labels(logits: jax.Array, labels: jax.Array,
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(
             f"label_smoothing must be in [0, 1), got {label_smoothing}")
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(
-        logits, labels[..., None], axis=-1).squeeze(-1)
-    if label_smoothing:
-        eps = label_smoothing
-        picked = (1.0 - eps) * picked + eps * jnp.mean(logits, axis=-1)
-    ll = picked - logz
-    return -_masked_mean(ll, where)
+    return _masked_mean(
+        token_nll(logits, labels, label_smoothing=label_smoothing), where)
 
 
 def sigmoid_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:

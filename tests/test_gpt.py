@@ -209,3 +209,68 @@ def test_ring_attention_seq_parallel_matches_plain(cpu8):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
         g2, g1)
+
+
+def test_chunked_lm_loss_matches_full():
+    """loss_chunk computes per-chunk logits under jax.checkpoint; loss,
+    accuracy AND grads must equal the full-logits pass (the knob exists
+    so long-context/big-batch causal training never materializes
+    [B, S, vocab] — measured OOM at b64 s512 on the chip without it)."""
+    from distributed_tensorflow_example_tpu.models.gpt import (GPT,
+                                                               GPTConfig)
+    cfg = GPTConfig.tiny()
+    cfg.dropout = 0.0
+    full = GPT(cfg)
+    cfg2 = GPTConfig.tiny()
+    cfg2.dropout = 0.0
+    cfg2.loss_chunk = 16
+    chunked = GPT(cfg2)
+    params = full.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": jnp.asarray(
+                 rs.randint(0, 1000, (4, 32), dtype=np.int32)),
+             "attention_mask": jnp.asarray(
+                 (rs.rand(4, 32) > 0.2).astype(np.int32))}
+    (l1, (a1, _)), g1 = jax.jit(jax.value_and_grad(
+        lambda p: full.loss(p, {}, batch, None), has_aux=True))(params)
+    (l2, (a2, _)), g2 = jax.jit(jax.value_and_grad(
+        lambda p: chunked.loss(p, {}, batch, None), has_aux=True))(params)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(float(a2["token_accuracy"]),
+                               float(a1["token_accuracy"]), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6), g2, g1)
+    # eval rides the chunked path too (the final eval of a chunked run
+    # must not re-materialize the full logits) — incl. __valid__ masking
+    eb = dict(batch)
+    eb["__valid__"] = jnp.asarray(np.asarray([1, 1, 1, 0], np.float32))
+    ef = full.eval_metrics(params, {}, eb)
+    ec = chunked.eval_metrics(params, {}, eb)
+    for k in ("loss", "perplexity", "token_accuracy"):
+        np.testing.assert_allclose(float(ec[k]), float(ef[k]), rtol=1e-6,
+                                   err_msg=k)
+
+
+def test_chunked_lm_loss_indivisible_is_loud():
+    from distributed_tensorflow_example_tpu.models.gpt import (GPT,
+                                                               GPTConfig)
+    cfg = GPTConfig.tiny()
+    cfg.loss_chunk = 7
+    m = GPT(cfg)
+    params = m.init(jax.random.key(0))
+    with pytest.raises(ValueError, match="loss_chunk"):
+        m.loss(params, {}, m.dummy_batch(2), None)   # 7 does not divide 128
+
+
+def test_lm_loss_chunk_cli_knob():
+    cfg = TrainConfig(model="gpt_tiny", lm_loss_chunk=16)
+    m = get_model("gpt_tiny", cfg)
+    assert m.cfg.loss_chunk == 16
+    with pytest.raises(ValueError, match="lm_loss_chunk"):
+        get_model("gpt_tiny", TrainConfig(model="gpt_tiny",
+                                          lm_loss_chunk=-1))
+    from distributed_tensorflow_example_tpu.cli.train import main
+    with pytest.raises(SystemExit, match="causal-LM knob"):
+        main(["--model", "mlp", "--train_steps", "1",
+              "--lm_loss_chunk", "16"])
