@@ -222,10 +222,21 @@ class GPT:
         """Sequence-chunked next-token loss: per chunk, compute the
         [B, chunk, V] logits + xent and DROP them (jax.checkpoint), so
         the full [B, S, V] tensor never exists in forward or backward.
-        Returns (loss, accuracy) with identical semantics to the full
-        pass (weighted token mean)."""
+        ``h`` covers all S positions; ``targets``/``w`` are the S-1
+        shifted labels/weights — this helper pads them with a weight-0
+        dummy at position S-1 and validates divisibility, so loss() and
+        eval_metrics() share ONE setup. Returns (loss, accuracy) with
+        identical semantics to the full pass (weighted token mean)."""
         b, s, hid = h.shape
-        n = s // chunk          # caller guarantees divisibility
+        if s % chunk:
+            raise ValueError(
+                f"loss_chunk={chunk} must divide seq_len={s} (a silent "
+                "full-logits fallback would OOM exactly the configs the "
+                "knob exists for)")
+        targets = jnp.concatenate(
+            [targets, jnp.zeros_like(targets[:, :1])], axis=1)
+        w = jnp.concatenate([w, jnp.zeros_like(w[:, :1])], axis=1)
+        n = s // chunk
         hs = h.reshape(b, n, chunk, hid).transpose(1, 0, 2, 3)
         ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
         ws = w.reshape(b, n, chunk).transpose(1, 0, 2)
@@ -255,22 +266,8 @@ class GPT:
         w = mask[:, 1:].astype(jnp.float32)
         chunk = self.cfg.loss_chunk
         if chunk:
-            ids = batch["input_ids"]
-            S = ids.shape[1]
-            if S % chunk:
-                raise ValueError(
-                    f"loss_chunk={chunk} must divide seq_len={S} "
-                    "(a silent full-logits fallback would OOM exactly "
-                    "the configs the knob exists for)")
-            # chunk over the FULL S positions (powers of two divide):
-            # position S-1 predicts nothing — its target is a dummy with
-            # weight 0
             h = self.encode(params, batch, rng, train=True)
-            t_full = jnp.concatenate(
-                [targets, jnp.zeros_like(targets[:, :1])], axis=1)
-            w_full = jnp.concatenate(
-                [w, jnp.zeros_like(w[:, :1])], axis=1)
-            loss, acc = self._chunked_lm_loss(params, h, t_full, w_full,
+            loss, acc = self._chunked_lm_loss(params, h, targets, w,
                                               chunk)
             return loss, ({"token_accuracy": acc}, extras)
         logits, new_extras = self.apply(params, extras, batch, rng,
@@ -295,17 +292,8 @@ class GPT:
             # same memory wall as training: the final eval of a chunked
             # run must not materialize the full [B, S, vocab] tensor the
             # knob exists to avoid
-            ids = batch["input_ids"]
-            if ids.shape[1] % chunk:
-                raise ValueError(
-                    f"loss_chunk={chunk} must divide seq_len="
-                    f"{ids.shape[1]}")
             h = self.encode(params, batch, train=False)
-            t_full = jnp.concatenate(
-                [targets, jnp.zeros_like(targets[:, :1])], axis=1)
-            w_full = jnp.concatenate(
-                [w, jnp.zeros_like(w[:, :1])], axis=1)
-            loss, acc = self._chunked_lm_loss(params, h, t_full, w_full,
+            loss, acc = self._chunked_lm_loss(params, h, targets, w,
                                               chunk)
         else:
             logits, _ = self.apply(params, extras, batch, train=False)

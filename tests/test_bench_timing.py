@@ -57,3 +57,25 @@ def test_no_flops_estimate_uses_disagreement_only():
     # documented limitation — the helper still returns the measurement
     dt, suspect = robust_time(_passes([0.001, 0.001]), steps=10)
     assert dt == pytest.approx(0.001) and not suspect
+
+
+def test_vs_baseline_excludes_suspect_measurements():
+    """A corrupt (suspect-flagged) reading must not move the gate: the
+    round-4 incident was a ResNet 'step' of 2.46 ms / 6.28 MFU through
+    the tunnel inflating vs_baseline to 1.8x despite robust_time having
+    FLAGGED it."""
+    import importlib.util, os
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    base = {"mnist_mlp_eps_chip": 100.0, "resnet50_eps_chip": 100.0}
+    clean = {"mnist_mlp_eps_chip": 110.0, "resnet50_eps_chip": 110.0}
+    assert abs(bench.vs_baseline_geomean(clean, base) - 1.1) < 1e-9
+    corrupt = dict(clean, resnet50_eps_chip=5000.0, resnet50_suspect=True)
+    # the corrupt 50x reading is excluded; only mnist's 1.1 remains
+    assert abs(bench.vs_baseline_geomean(corrupt, base) - 1.1) < 1e-9
+    # all-suspect -> neutral 1.0, not a crash
+    allbad = {"mnist_mlp_eps_chip": 5000.0, "mnist_mlp_suspect": True}
+    assert bench.vs_baseline_geomean(allbad, base) == 1.0
