@@ -43,6 +43,39 @@ def serving_signature(batch: dict[str, Any]) -> dict[str, Any]:
     return {k: v for k, v in batch.items() if k not in _LABEL_KEYS}
 
 
+def _write_artifact(out_dir: str, exported, features, params, model,
+                    **extra_meta) -> str:
+    """Chief-only artifact + metadata write shared by every exporter
+    (one metadata schema, one serializer — exporters add their own keys
+    via ``extra_meta``)."""
+    artifact = os.path.join(out_dir, _ARTIFACT)
+    if jax.process_index() != 0:
+        # any gather the caller did was collective (all processes); the
+        # artifact write is chief-only — same division as the
+        # checkpoint writer
+        return artifact
+    os.makedirs(out_dir, exist_ok=True)
+    with open(artifact, "wb") as f:
+        f.write(exported.serialize())
+    signature = {
+        k: {"shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype)}
+        for k, v in features.items()}
+    with open(os.path.join(out_dir, _META), "w") as f:
+        json.dump({
+            "model": getattr(model, "name", type(model).__name__),
+            "input_signature": signature,
+            "platforms": list(exported.platforms),
+            "param_count": sum(
+                int(np.size(p))
+                for p in jax.tree_util.tree_leaves(params)),
+            "jax_version": jax.__version__,
+            "calling_convention_version":
+                exported.calling_convention_version,
+            **extra_meta,
+        }, f, indent=1)
+    return artifact
+
+
 def export_model(model, params, extras, out_dir: str, *,
                  sample_batch: dict[str, Any] | None = None,
                  batch_size: int = 8,
@@ -108,31 +141,52 @@ def export_model(model, params, extras, out_dir: str, *,
     else:
         exported = _export(False)
 
-    artifact = os.path.join(out_dir, _ARTIFACT)
-    if jax.process_index() != 0:
-        # the gather above is collective (all processes), the artifact
-        # write is chief-only — same division as the checkpoint writer
-        return artifact
-    os.makedirs(out_dir, exist_ok=True)
-    with open(artifact, "wb") as f:
-        f.write(exported.serialize())
-    signature = {
-        k: {"shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype)}
-        for k, v in features.items()}
-    with open(os.path.join(out_dir, _META), "w") as f:
-        json.dump({
-            "model": getattr(model, "name", type(model).__name__),
-            "input_signature": signature,
-            "batch_polymorphic": batch_polymorphic,
-            "platforms": list(platforms),
-            "param_count": sum(
-                int(np.size(p))
-                for p in jax.tree_util.tree_leaves(params)),
-            "jax_version": jax.__version__,
-            "calling_convention_version":
-                exported.calling_convention_version,
-        }, f, indent=1)
-    return artifact
+    return _write_artifact(out_dir, exported, features, params, model,
+                           batch_polymorphic=batch_polymorphic)
+
+
+def export_generator(model, params, out_dir: str, *,
+                     prompt_len: int, max_new_tokens: int,
+                     batch_size: int = 1, temperature: float = 0.0,
+                     platforms: Sequence[str] = ("cpu", "tpu")) -> str:
+    """Serialize ``model.generate`` (params baked, greedy or fixed-
+    temperature sampling) as a self-contained decode artifact: the whole
+    generation — prefill + the KV-cache ``lax.scan`` — is ONE StableHLO
+    program mapping ``{"input_ids": [B, prompt_len]}`` (plus ``"rng"``
+    when sampling) to ``[B, max_new_tokens]`` token ids. Static shapes
+    throughout (the decode loop's cache layout depends on prompt and
+    generation lengths, so the artifact is inherently static-shape; the
+    metadata records it as such)."""
+    from .ckpt.checkpoint import _to_host
+    params = jax.tree_util.tree_map(_to_host, params)
+
+    sampled = temperature > 0.0
+    if sampled:
+        def serve(feats):
+            return model.generate(params, feats["input_ids"],
+                                  max_new_tokens,
+                                  temperature=temperature,
+                                  rng=jax.random.wrap_key_data(
+                                      feats["rng"]))
+    else:
+        def serve(feats):
+            return model.generate(params, feats["input_ids"],
+                                  max_new_tokens)
+
+    features = {"input_ids": np.zeros((batch_size, prompt_len), np.int32)}
+    if sampled:
+        features["rng"] = np.zeros(
+            np.shape(jax.random.key_data(jax.random.key(0))), np.uint32)
+    specs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        features)
+    exported = jax_export.export(
+        jax.jit(serve), platforms=list(platforms))(specs)
+
+    return _write_artifact(out_dir, exported, features, params, model,
+                           kind="generator", batch_polymorphic=False,
+                           max_new_tokens=max_new_tokens,
+                           temperature=temperature)
 
 
 class ServableModel:
