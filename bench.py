@@ -286,6 +286,16 @@ def vs_baseline_geomean(extra: dict, base: dict) -> float:
 
 
 def main() -> None:
+    # persistent compilation cache: the 6-workload gate is ~6
+    # executables x ~40-60 s of (remote) compile when cold — enough to
+    # brush up against driver timeouts. Verified to work through the
+    # axon tunnel (second-process compile 2.3 s -> 0.8 s); a warmed
+    # cache makes the round-end bench compile-free (measured 3 min for
+    # the full gate). Set HERE, not at import: importers of bench
+    # helpers (tests, bench_scaling) must not inherit the cache.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("DTX_JAX_CACHE",
+                                     "/tmp/dtx_jax_cache"))
     only = os.environ.get("BENCH_ONLY", "").split(",") if \
         os.environ.get("BENCH_ONLY") else None
     on_tpu = jax.devices()[0].platform == "tpu"
