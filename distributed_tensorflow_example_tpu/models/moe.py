@@ -165,15 +165,31 @@ class MoeBert(Bert):
 
     # ------------------------------------------------------------------
     def sharding_rules(self, mesh_shape) -> ShardingRules:
-        """Bert's Megatron TP rules + expert-sharded MoE weights."""
+        """Bert's Megatron TP rules + expert-sharded MoE weights.
+
+        EP × TP (VERDICT r4 task #7): with BOTH ``expert`` and ``model``
+        axes > 1, each expert's FFN kernels are additionally
+        Megatron-split over ``model`` — w_in [E, H, I/tp] column-wise,
+        w_out [E, I/tp, H] row-wise — so the dense dispatch/combine
+        einsums run with the token exchange over ``expert`` AND the
+        per-expert matmul reduction over ``model`` in one GSPMD program
+        (a model-axis psum closes each expert FFN, exactly the dense-FFN
+        Megatron pattern). Either axis alone degrades to plain EP or
+        plain per-expert TP."""
         E = AxisNames.EXPERT
+        M = AxisNames.MODEL
         base = super().sharding_rules(mesh_shape)
         ep = getattr(mesh_shape, "expert", 1) if mesh_shape else 1
-        if ep <= 1:
+        tp = getattr(mesh_shape, "model", 1) if mesh_shape else 1
+        e = E if ep > 1 else None
+        m = M if tp > 1 else None
+        if e is None and m is None:
             return base
         rules = [
-            (r"moe/w_(in|out)", P(E, None, None)),
-            (r"moe/b_(in|out)", P(E, None)),
+            (r"moe/w_in", P(e, None, m)),
+            (r"moe/b_in", P(e, m)),
+            (r"moe/w_out", P(e, m, None)),
+            (r"moe/b_out", P(e, None)),
         ] + list(base.rules)
         return ShardingRules(rules=rules,
                              fsdp_axis_size=base.fsdp_axis_size)

@@ -140,9 +140,15 @@ def _route(router_params: Params, x2: jax.Array, n_experts: int, k: int,
     return dispatch, combine, stats
 
 
-def _expert_compute(params: Params, inp: jax.Array, dtype) -> jax.Array:
+def _expert_compute(params: Params, inp: jax.Array, dtype, *,
+                    psum_axis: str | None = None) -> jax.Array:
     """[E, C, D] -> [E, C, D]: the per-expert FFN (batched einsum over E —
-    one MXU matmul per expert, stacked)."""
+    one MXU matmul per expert, stacked).
+
+    ``psum_axis``: Megatron TP inside each expert — the caller holds
+    w_in [E, H, I/tp] / w_out [E, I/tp, H] slices, the intermediate dim
+    is partial, and the output contraction is closed by a psum over the
+    named axis BEFORE the (full, unsharded-along-I) output bias."""
     h = jnp.einsum("ecd,edh->ech", inp.astype(dtype),
                    params["w_in"].astype(dtype),
                    preferred_element_type=jnp.float32)
@@ -150,6 +156,8 @@ def _expert_compute(params: Params, inp: jax.Array, dtype) -> jax.Array:
     h = jax.nn.gelu(h).astype(dtype)
     out = jnp.einsum("ech,ehd->ecd", h, params["w_out"].astype(dtype),
                      preferred_element_type=jnp.float32)
+    if psum_axis is not None:
+        out = lax.psum(out, psum_axis)
     return out + params["b_out"][:, None, :]
 
 
@@ -205,11 +213,19 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
                       capacity_factor: float = 1.25, dtype=jnp.float32,
                       axis_name: str = "expert",
                       batch_axes=("data", "fsdp"),
+                      model_axis: str | None = None,
                       rng: jax.Array | None = None,
                       jitter: float = 0.0) -> tuple[jax.Array, dict]:
     """Explicit expert-parallel MoE: tokens sharded over the ``expert``
     axis, weights sharded one-expert-group-per-rank, exchange via
     ``lax.all_to_all`` (the EP collective; parallel/collectives.py).
+
+    ``model_axis``: EP × TP — each local expert's FFN kernels are
+    additionally Megatron-split over this axis (w_in [e, H, I/tp],
+    w_out [e, I/tp, H]); every model rank routes the SAME tokens with
+    the same rng (the model axis is deliberately NOT folded into the
+    jitter key), runs its kernel slice, and a psum over ``model_axis``
+    closes each expert FFN before the output bias.
 
     Output semantics match :func:`moe_ffn` exactly when no token is
     dropped (capacity is per-(source rank, expert) here, so use a
@@ -227,6 +243,12 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
     if n_experts % n_ranks:
         raise ValueError(f"{n_experts} experts not divisible over "
                          f"{n_ranks} '{axis_name}' ranks")
+    if model_axis is not None:
+        inter = params["w_in"].shape[2]
+        if inter % mesh.shape[model_axis]:
+            raise ValueError(
+                f"intermediate dim {inter} not divisible over "
+                f"{mesh.shape[model_axis]} '{model_axis}' ranks")
     batch_axes = tuple(a for a in batch_axes if a in mesh.shape)
     stat_axes = batch_axes + (axis_name,)
 
@@ -260,7 +282,7 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
         recv = recv.reshape(e_local, n_ranks * cap, dl)
         out = _expert_compute(
             {k: v for k, v in p_local.items() if k != "router"},
-            recv, dtype)                                     # [e_l, nC, D]
+            recv, dtype, psum_axis=model_axis)               # [e_l, nC, D]
         # send results back: invert the regrouping then all_to_all again
         back = out.reshape(e_local, n_ranks, cap, dl).transpose(1, 0, 2, 3)
         back = back.reshape(n_ranks * e_local, cap, dl)
@@ -277,11 +299,12 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
         return y.reshape(bl, sl, dl).astype(x_local.dtype), aux
 
     xspec = P(batch_axes, axis_name, None)
+    tp = model_axis
     pspec = {
         "router": jax.tree_util.tree_map(lambda _: P(), params["router"]),
-        "w_in": P(axis_name, None, None),
-        "b_in": P(axis_name, None),
-        "w_out": P(axis_name, None, None),
+        "w_in": P(axis_name, None, tp),
+        "b_in": P(axis_name, tp),
+        "w_out": P(axis_name, tp, None),
         "b_out": P(axis_name, None),
     }
     aux_spec = {"lb_loss": P(), "z_loss": P(), "dropped_fraction": P(),

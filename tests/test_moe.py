@@ -483,3 +483,101 @@ def test_moe_bert_composes_ep_with_fsdp(cpu8):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
         jax.device_get(state_c.params), jax.device_get(state_r.params))
+
+
+# ---------------------------------------------------------------------------
+# EP x TP (VERDICT r4 task #7): expert FFN kernels Megatron-split over
+# `model` inside each expert, composing with the expert-axis exchange
+# ---------------------------------------------------------------------------
+
+def test_moe_shard_map_ep_x_tp_matches_dense(cpu8):
+    """Explicit EP with model_axis set: tokens exchange over `expert`
+    while each expert's FFN runs as a Megatron column/row split over
+    `model` closed by a psum — output and aux must match the
+    single-device dense path."""
+    mesh = local_mesh(8, {"data": 2, "expert": 2, "model": 2})
+    params = _params(n_experts=4, hidden=16, inter=32)
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(4, 16, 16).astype(np.float32))
+    dense, aux_d = moe.moe_ffn(params, x, n_experts=4,
+                               capacity_factor=8.0)
+    ep, aux_e = moe.moe_ffn_shard_map(params, x, mesh, n_experts=4,
+                                      capacity_factor=8.0,
+                                      batch_axes=("data",),
+                                      model_axis="model")
+    np.testing.assert_allclose(np.asarray(ep), np.asarray(dense),
+                               rtol=1e-5, atol=1e-6)
+    for k in ("lb_loss", "z_loss", "dropped_fraction"):
+        np.testing.assert_allclose(float(aux_e[k]), float(aux_d[k]),
+                                   rtol=1e-5, atol=1e-7, err_msg=k)
+
+
+def test_moe_shard_map_ep_x_tp_grads_match_dense(cpu8):
+    """Gradients through the EP x TP shard_map (all_to_all + psum both
+    on the backward path) equal the dense path's."""
+    mesh = local_mesh(4, {"expert": 2, "model": 2})
+    params = _params(n_experts=4, hidden=16, inter=32, seed=5)
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(2, 16, 16).astype(np.float32))
+
+    def loss_dense(p):
+        y, aux = moe.moe_ffn(p, x, n_experts=4, capacity_factor=8.0)
+        return jnp.sum(y ** 2) + aux["lb_loss"]
+
+    def loss_ep(p):
+        y, aux = moe.moe_ffn_shard_map(p, x, mesh, n_experts=4,
+                                       capacity_factor=8.0,
+                                       batch_axes=(),
+                                       model_axis="model")
+        return jnp.sum(y ** 2) + aux["lb_loss"]
+
+    gd = jax.jit(jax.grad(loss_dense))(params)
+    ge = jax.jit(jax.grad(loss_ep))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5), ge, gd)
+
+
+def test_moe_shard_map_tp_indivisible_is_loud(cpu8):
+    mesh = local_mesh(4, {"expert": 2, "model": 2})
+    params = _params(n_experts=4, hidden=16, inter=31)
+    x = jnp.zeros((2, 16, 16), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        moe.moe_ffn_shard_map(params, x, mesh, n_experts=4,
+                              batch_axes=(), model_axis="model")
+
+
+def test_moe_bert_composes_ep_with_tp(cpu8):
+    """The production (dense-dispatch GSPMD) path on a
+    {data, expert, model} mesh: sharding rules put expert FFN kernels on
+    BOTH axes (w_in [E, H, I/tp]), attention kernels on `model`, and
+    training matches the single-axis replicated run."""
+    m = _tiny_moe()
+    batch = m.dummy_batch(8)
+
+    def run(mesh_shape, n):
+        mesh = local_mesh(n, mesh_shape)
+        mm = _tiny_moe()
+        rules = mm.sharding_rules(MeshShape(**mesh_shape))
+        tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1))
+        sync = SyncReplicas(mm.loss, tx, mesh, rules=rules)
+        state = sync.init(mm.init, seed=0)
+        placed = sync.shard_batch(batch)
+        losses = []
+        for _ in range(3):
+            state, metr = sync.step(state, placed)
+            losses.append(float(metr["loss"]))
+        return losses, state
+
+    losses_c, state_c = run({"data": 2, "expert": 2, "model": 2}, 8)
+    losses_r, state_r = run({"data": 2}, 2)
+    np.testing.assert_allclose(losses_c, losses_r, rtol=1e-5, atol=1e-6)
+    w_in = state_c.params["layer_1"]["moe"]["w_in"]
+    spec = str(w_in.sharding.spec)
+    assert "expert" in spec and "model" in spec, w_in.sharding
+    qk = state_c.params["layer_0"]["attn"]["q"]["kernel"]
+    assert "model" in str(qk.sharding.spec), qk.sharding
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        jax.device_get(state_c.params), jax.device_get(state_r.params))

@@ -121,6 +121,52 @@ def _single_process_reference():
     ref["pptp_params"] = [np.asarray(p) for p in
                           jax.tree_util.tree_leaves(
                               jax.device_get(tstate.params))]
+
+    # EP x TP: MoeBert with expert weights on BOTH axes
+    mesh = local_mesh(8, {"data": 2, "expert": 2, "model": 2})
+    ecfg = MoeBertConfig.tiny()
+    ecfg.dropout = 0.0
+    emodel = MoeBert(ecfg)
+    esync = SyncReplicas(emodel.loss,
+                         make_optimizer(OptimizerConfig(
+                             name="sgd", learning_rate=0.1)),
+                         mesh, rules=emodel.sharding_rules(
+                             MeshShape(data=2, expert=2, model=2)))
+    estate = esync.init(emodel.init, seed=15)
+    ebatch = esync.shard_batch(emodel.dummy_batch(8))
+    elosses = []
+    for _ in range(2):
+        estate, m = esync.step(estate, ebatch)
+        elosses.append(float(jax.device_get(m["loss"])))
+    ref["eptp_losses"] = np.asarray(elosses)
+    ref["eptp_params"] = [np.asarray(p) for p in
+                          jax.tree_util.tree_leaves(
+                              jax.device_get(estate.params))]
+
+    # SP: causal ring attention over the seq axis
+    from distributed_tensorflow_example_tpu.models.gpt import (GPT,
+                                                               GPTConfig)
+    from distributed_tensorflow_example_tpu.parallel.ring_attention import (
+        make_ring_attention)
+    mesh = local_mesh(8, {"data": 4, "seq": 2})
+    gcfg = GPTConfig.tiny()
+    gcfg.dropout = 0.0
+    gmodel = GPT(gcfg, attention_fn=make_ring_attention(mesh, causal=True))
+    gsync = SyncReplicas(gmodel.loss,
+                         make_optimizer(OptimizerConfig(
+                             name="sgd", learning_rate=0.1)),
+                         mesh, rules=gmodel.sharding_rules(
+                             MeshShape(data=4, seq=2)))
+    gstate = gsync.init(gmodel.init, seed=14)
+    gbatch = gsync.shard_batch(gmodel.dummy_batch(8))
+    glosses = []
+    for _ in range(2):
+        gstate, m = gsync.step(gstate, gbatch)
+        glosses.append(float(jax.device_get(m["loss"])))
+    ref["sp_losses"] = np.asarray(glosses)
+    ref["sp_params"] = [np.asarray(p) for p in
+                        jax.tree_util.tree_leaves(
+                            jax.device_get(gstate.params))]
     return ref
 
 
@@ -150,3 +196,16 @@ def test_cross_host_matches_single_process(ep_pp_result):
     for i, want in enumerate(ref["pptp_params"]):
         np.testing.assert_allclose(z0[f"pptp_p{i}"], want, rtol=1e-4,
                                    atol=1e-5, err_msg=f"pptp leaf {i}")
+    # EP x TP (both the token all_to_all AND the per-expert Megatron
+    # psum cross hosts) and SP (causal ring attention's ppermute across
+    # hosts): same parity bars as their collective families above
+    np.testing.assert_allclose(z0["eptp_losses"], ref["eptp_losses"],
+                               rtol=1e-5, atol=1e-6)
+    for i, want in enumerate(ref["eptp_params"]):
+        np.testing.assert_allclose(z0[f"eptp_p{i}"], want, rtol=1e-4,
+                                   atol=1e-5, err_msg=f"eptp leaf {i}")
+    np.testing.assert_allclose(z0["sp_losses"], ref["sp_losses"],
+                               rtol=1e-5, atol=1e-6)
+    for i, want in enumerate(ref["sp_params"]):
+        np.testing.assert_allclose(z0[f"sp_p{i}"], want, rtol=1e-4,
+                                   atol=1e-5, err_msg=f"sp leaf {i}")
