@@ -75,6 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps_per_loop", type=int, default=1,
                    help="training steps per device dispatch (lax.scan "
                         "inner loop; hook cadences must be multiples)")
+    p.add_argument("--max_inflight_steps", type=int, default=0,
+                   help="block the host every N trained steps, bounding "
+                        "the async dispatch queue (0 = unbounded, the "
+                        "normal fast path; set small — e.g. 1-2 — on "
+                        "runtime stacks that misbehave under deep "
+                        "dispatch queues)")
     p.add_argument("--learning_rate", type=float, default=0.5)
     p.add_argument("--optimizer", default="sgd", type=str.lower,
                    choices=["sgd", "momentum", "adam", "adamw",
@@ -154,6 +160,41 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write a serving artifact (StableHLO via "
                         "jax.export, params baked in, batch-polymorphic) "
                         "after training — the SavedModel-parity path")
+    p.add_argument("--export_generator", default=None, metavar="DIR",
+                   help="write a DECODE artifact (the whole KV-cache "
+                        "generation as one StableHLO program, params "
+                        "baked) after training — causal-LM models "
+                        "(gpt/gpt_tiny) only; shape/sampling come from "
+                        "the --gen_* flags")
+    p.add_argument("--gen_prompt_len", type=int, default=128,
+                   help="prompt length the generator artifact accepts "
+                        "(static shape)")
+    p.add_argument("--gen_max_new", type=int, default=128,
+                   help="tokens the generator artifact emits")
+    p.add_argument("--gen_batch", type=int, default=1,
+                   help="generator artifact batch size (static; the "
+                        "REST server pads smaller requests)")
+    p.add_argument("--gen_temperature", type=float, default=0.0,
+                   help="0 = greedy; > 0 samples (artifact then takes "
+                        "a seed)")
+    p.add_argument("--gen_top_k", type=int, default=0,
+                   help="sample from the k most likely tokens only "
+                        "(0 = off; needs --gen_temperature > 0)")
+    p.add_argument("--gen_top_p", type=float, default=0.0,
+                   help="nucleus sampling: smallest token set with "
+                        "cumulative probability >= p (0 = off; needs "
+                        "--gen_temperature > 0)")
+    p.add_argument("--gen_eos_id", type=int, default=None,
+                   help="stop a row at this token id (emitted, then "
+                        "--gen_pad_id fills the tail; the decode loop "
+                        "exits early device-side when every row is "
+                        "done)")
+    p.add_argument("--gen_pad_id", type=int, default=0,
+                   help="tail filler after --gen_eos_id fires")
+    p.add_argument("--gen_ragged", action="store_true",
+                   help="artifact additionally takes a prompt_mask "
+                        "feature (1 = real token) for ragged prompt "
+                        "batches")
     p.add_argument("--warm_start", default=None,
                    help="checkpoint file/dir to initialize params from "
                         "when starting fresh (tf.train.init_from_"
@@ -319,6 +360,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         early_stop_patience=args.early_stop_patience,
         early_stop_mode=args.early_stop_mode,
         steps_per_loop=args.steps_per_loop,
+        max_inflight_steps=args.max_inflight_steps,
         seed=args.seed,
         dtype=args.dtype,
         param_dtype=args.param_dtype,
@@ -547,20 +589,24 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
     if args.eval_only and not args.ckpt_dir:
         # fail fast: everything below (dataset load, mesh, Trainer) can
         # take minutes for the big datasets
         raise SystemExit("--eval_only requires --ckpt_dir")
-    if args.export_dir:
+    for flag, d in (("--export_dir", args.export_dir),
+                    ("--export_generator", args.export_generator)):
+        if not d:
+            continue
         # fail fast on an unwritable export target too — discovering a
         # PermissionError AFTER a multi-hour run wastes the whole run
         try:
-            os.makedirs(args.export_dir, exist_ok=True)
-            if not os.access(args.export_dir, os.W_OK):
-                raise PermissionError(args.export_dir)
+            os.makedirs(d, exist_ok=True)
+            if not os.access(d, os.W_OK):
+                raise PermissionError(d)
         except OSError as e:
-            raise SystemExit(f"--export_dir is not writable: {e}")
+            raise SystemExit(f"{flag} is not writable: {e}")
     if args.label_smoothing and args.model not in ("lenet", "resnet20",
                                                    "resnet50"):
         # a silently ignored training knob is worse than an error
@@ -571,6 +617,39 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(
             f"--lm_loss_chunk is a causal-LM knob (gpt/gpt_tiny), not "
             f"for model {args.model!r}")
+    if args.export_generator and not args.model.startswith("gpt"):
+        raise SystemExit(
+            f"--export_generator is a causal-LM knob (gpt/gpt_tiny), "
+            f"not for model {args.model!r} — only decoder models have "
+            "a KV-cache generate path")
+    gen_dests = [d for d in vars(args)
+                 if d.startswith("gen_")]     # every --gen_* flag
+    if not args.export_generator:
+        for d in gen_dests:
+            if getattr(args, d) != parser.get_default(d):
+                raise SystemExit(
+                    f"--{d} configures the generator artifact and "
+                    "does nothing without --export_generator DIR")
+    else:
+        # fail fast on knob combinations generate() would reject AFTER
+        # the (possibly multi-hour) training run — same rationale as the
+        # export-dir writability precheck above
+        if ((args.gen_top_k or args.gen_top_p)
+                and args.gen_temperature <= 0.0):
+            raise SystemExit(
+                "--gen_top_k/--gen_top_p shape the sampling "
+                "distribution; set --gen_temperature > 0")
+        if not 0.0 <= args.gen_top_p <= 1.0:
+            raise SystemExit(
+                f"--gen_top_p must be in [0, 1], got {args.gen_top_p}")
+        if args.gen_top_k < 0:
+            raise SystemExit(
+                f"--gen_top_k must be >= 0, got {args.gen_top_k}")
+        for flag, v in (("--gen_prompt_len", args.gen_prompt_len),
+                        ("--gen_max_new", args.gen_max_new),
+                        ("--gen_batch", args.gen_batch)):
+            if v < 1:
+                raise SystemExit(f"{flag} must be >= 1, got {v}")
     for flag, val in (("--moe_experts", args.moe_experts),
                       ("--moe_top_k", args.moe_top_k),
                       ("--moe_capacity_factor", args.moe_capacity_factor),
@@ -605,6 +684,20 @@ def main(argv: list[str] | None = None) -> int:
     from ..train.trainer import Trainer
 
     model = get_model(cfg.model, cfg)
+    if args.export_generator:
+        # the generator prechecks that need the model: fail BEFORE
+        # training, not in the post-run export
+        ml = getattr(getattr(model, "cfg", None), "max_len", None)
+        if ml and args.gen_prompt_len + args.gen_max_new > ml:
+            raise SystemExit(
+                f"--gen_prompt_len {args.gen_prompt_len} + "
+                f"--gen_max_new {args.gen_max_new} exceeds the model's "
+                f"max_len {ml}")
+        vs = getattr(getattr(model, "cfg", None), "vocab_size", None)
+        if vs and args.gen_top_k > vs:
+            raise SystemExit(
+                f"--gen_top_k {args.gen_top_k} exceeds the model's "
+                f"vocab_size {vs}")
     train_arrays, eval_arrays = load_dataset(cfg, model,
                                              eval_only=args.eval_only)
     train_transform = None
@@ -682,21 +775,37 @@ def main(argv: list[str] | None = None) -> int:
 
 def _maybe_export(args, cfg, model, state, ctx) -> None:
     """SavedModel-parity export of the trained forward (EMA shadow when
-    enabled — the tf export recipe used ema variables). The host gather
-    inside export_model is collective, so every process enters; only
+    enabled — the tf export recipe used ema variables) and, for causal
+    LMs, the ``--export_generator`` decode artifact. The host gather
+    inside the exporters is collective, so every process enters; only
     process 0 writes."""
-    if not args.export_dir:
+    if not (args.export_dir or args.export_generator):
         return
-    from ..serving import export_model
     from ..train.optimizers import find_ema_params
     params = (find_ema_params(state.opt_state)
               if cfg.optimizer.ema_decay > 0 else None)
-    artifact = export_model(
-        model, params if params is not None else state.params,
-        state.extras, args.export_dir,
-        batch_size=min(8, cfg.data.batch_size))
-    if (ctx.process_index if ctx else 0) == 0:
-        log.info("exported servable: %s", artifact)
+    params = params if params is not None else state.params
+    chief = (ctx.process_index if ctx else 0) == 0
+    if args.export_dir:
+        from ..serving import export_model
+        artifact = export_model(
+            model, params, state.extras, args.export_dir,
+            batch_size=min(8, cfg.data.batch_size))
+        if chief:
+            log.info("exported servable: %s", artifact)
+    if args.export_generator:
+        from ..serving import export_generator
+        artifact = export_generator(
+            model, params, args.export_generator,
+            prompt_len=args.gen_prompt_len,
+            max_new_tokens=args.gen_max_new,
+            batch_size=args.gen_batch,
+            temperature=args.gen_temperature,
+            top_k=args.gen_top_k, top_p=args.gen_top_p,
+            eos_id=args.gen_eos_id, pad_id=args.gen_pad_id,
+            ragged=args.gen_ragged)
+        if chief:
+            log.info("exported generator: %s", artifact)
 
 
 if __name__ == "__main__":

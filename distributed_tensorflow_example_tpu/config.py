@@ -239,6 +239,15 @@ class TrainConfig:
     steps_per_loop: int = 1          # steps per device dispatch (lax.scan
                                      # inner loop — TPU-era iterations_per_loop
                                      # semantics; hook cadences must divide)
+    max_inflight_steps: int = 0      # cap un-blocked step dispatches in
+                                     # flight: block the host every N
+                                     # trained steps (0 = let JAX's async
+                                     # queue run free — the right default;
+                                     # the knob exists as the documented
+                                     # mitigation for runtime stacks that
+                                     # misbehave under deep dispatch
+                                     # queues, e.g. the round-4 tunnel
+                                     # INVALID_ARGUMENT — BASELINE.md)
     seed: int = 0
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     param_dtype: str = "float32"

@@ -312,16 +312,21 @@ class GPT:
     # ------------------------------------------------------------------
     # autoregressive decoding (static-shape KV cache, one compiled scan)
     # ------------------------------------------------------------------
-    def _prefill(self, params, ids, total_len: int):
-        """Full causal forward over the dense prompt, additionally
-        returning per-layer K/V padded to ``total_len`` slots. Returns
-        (last_hidden [B,hid], caches {layer_i: {k, v}: [B,T,H,D]})."""
+    def _prefill(self, params, ids, total_len: int, *, mask=None,
+                 pos_ids=None):
+        """Full causal forward over the (possibly left-padded) prompt,
+        additionally returning per-layer K/V padded to ``total_len``
+        slots. ``mask``/``pos_ids`` serve the ragged-prompt path: pad
+        slots are attention-masked out and real tokens carry their own
+        positions. Returns (last_hidden [B,hid], caches
+        {layer_i: {k, v}: [B,T,H,D]})."""
         c = self.cfg
         _, s = ids.shape
-        mask = jnp.ones_like(ids)
-        h, _ = self._embed(params, ids,
-                           jnp.arange(s, dtype=jnp.int32)[None],
-                           rng=None, train=False)
+        if mask is None:
+            mask = jnp.ones_like(ids)
+        if pos_ids is None:
+            pos_ids = jnp.arange(s, dtype=jnp.int32)[None]
+        h, _ = self._embed(params, ids, pos_ids, rng=None, train=False)
         caches = {}
         pad = [(0, 0), (0, total_len - s), (0, 0), (0, 0)]
         for i in range(c.layers):
@@ -333,17 +338,21 @@ class GPT:
         h = nn.layernorm(params["ln_f"], h)
         return h[:, -1], caches
 
-    def _decode_step(self, params, caches, tok, pos):
+    def _decode_step(self, params, caches, tok, pos, pad=None):
         """One-token forward against the cache. ``tok`` [B] int32,
-        ``pos`` scalar (the position tok sits at). Returns (logits [B,V],
-        updated caches)."""
+        ``pos`` scalar (the CACHE SLOT tok sits at). ``pad`` [B] is the
+        per-row left-pad count of a ragged prompt: row b's token at slot
+        j holds position j - pad_b, and slots below pad_b are dead.
+        Returns (logits [B,V], updated caches)."""
         c = self.cfg
         b = tok.shape[0]
         total = jax.tree_util.tree_leaves(caches)[0].shape[1]
-        h, _ = self._embed(params, tok[:, None], pos[None, None],
+        if pad is None:
+            pad = jnp.zeros((b,), jnp.int32)
+        h, _ = self._embed(params, tok[:, None], (pos - pad)[:, None],
                            rng=None, train=False)
-        kmask = (jnp.arange(total, dtype=jnp.int32) <= pos)
-        kmask = jnp.broadcast_to(kmask, (b, total))
+        slots = jnp.arange(total, dtype=jnp.int32)
+        kmask = (slots[None, :] <= pos) & (slots[None, :] >= pad[:, None])
         new_caches = {}
         for i in range(c.layers):
             lp = params[f"layer_{i}"]
@@ -367,11 +376,49 @@ class GPT:
         h = nn.layernorm(params["ln_f"], h)
         return self.lm_logits(params, h)[:, 0], new_caches
 
+    def _filter_logits(self, logits, top_k: int, top_p: float):
+        """Nucleus/top-k filtering of [B, V] (temperature-scaled)
+        logits: everything outside the kept set drops to the shared
+        NEG_INF fill (exp underflows to exactly 0 under categorical).
+        top-p keeps the smallest prefix of the descending-probability
+        order whose EXCLUSIVE cumulative mass is < top_p — the top token
+        always survives."""
+        from ..ops.attention import NEG_INF
+        if top_k:
+            kth = lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, NEG_INF, logits)
+        if top_p > 0.0:
+            sl = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sl, axis=-1)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            thresh = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1,
+                             keepdims=True)
+            logits = jnp.where(logits < thresh, NEG_INF, logits)
+        return logits
+
     def generate(self, params, input_ids, max_new_tokens: int, *,
-                 temperature: float = 0.0, rng: jax.Array | None = None):
-        """Greedy (``temperature=0``) or sampled autoregressive
-        generation from a DENSE prompt (no padding — standard decode
-        entry). Returns [B, max_new_tokens] int32. Jit-compatible:
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, eos_id: int | None = None,
+                 pad_id: int = 0, prompt_mask=None,
+                 rng: jax.Array | None = None):
+        """Autoregressive generation — one compiled program (prefill +
+        KV-cache decode loop), greedy (``temperature=0``) or sampled
+        with optional ``top_k``/``top_p`` (nucleus) filtering.
+
+        ``prompt_mask`` [B, S0] (1 = real token) admits RAGGED prompt
+        batches: real tokens (left-aligned by convention; any layout is
+        compacted order-preserving) are repacked against the RIGHT edge
+        internally, so every row's prompt ends at slot S0-1 and the
+        decode loop advances one shared scalar cache slot — no per-row
+        scatter. Positions/attention account for the per-row pad count;
+        each row must contain at least one real token.
+
+        ``eos_id`` switches the fixed-trip ``lax.scan`` decode loop to a
+        ``lax.while_loop`` that STOPS once every row has emitted EOS
+        (the EOS itself is emitted; later slots hold ``pad_id``) — the
+        early exit is device-side, still one dispatch.
+
+        Returns [B, max_new_tokens] int32. Jit-compatible:
         ``jax.jit(partial(model.generate, max_new_tokens=K))``.
         """
         c = self.cfg
@@ -388,34 +435,109 @@ class GPT:
                 f"max_len {c.max_len}")
         if temperature > 0.0 and rng is None:
             raise ValueError("sampling (temperature > 0) needs rng")
+        if (top_k or top_p) and temperature <= 0.0:
+            raise ValueError("top_k/top_p shape the SAMPLING "
+                             "distribution; greedy decoding "
+                             "(temperature=0) would silently ignore "
+                             "them — set temperature > 0")
+        if not 0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if top_k < 0 or top_k > c.vocab_size:
+            raise ValueError(f"top_k must be in [0, vocab_size="
+                             f"{c.vocab_size}], got {top_k}")
 
-        last_h, caches = self._prefill(params, input_ids, total)
+        if prompt_mask is not None:
+            if tuple(prompt_mask.shape) != (b, s0):
+                raise ValueError(
+                    f"prompt_mask shape {tuple(prompt_mask.shape)} != "
+                    f"input_ids shape {(b, s0)}")
+            # normalize to 0/1 first: the docstring contract is "nonzero
+            # = real token", and a 2 in the mask would otherwise corrupt
+            # the pad count below (and disagree with the HTTP server's
+            # `!= 0` validation)
+            pm = (jnp.asarray(prompt_mask) != 0).astype(jnp.int32)
+            # stable argsort keys pads (0) first, real tokens (1) after
+            # IN ORDER: one gather right-packs every row
+            order = jnp.argsort(pm, axis=1, stable=True)
+            ids = jnp.take_along_axis(jnp.asarray(input_ids), order,
+                                      axis=1)
+            pad = (s0 - jnp.sum(pm, axis=1)).astype(jnp.int32)
+            valid = jnp.arange(s0, dtype=jnp.int32)[None, :] >= pad[:, None]
+            ids = jnp.where(valid, ids, 0)
+            pos_ids = jnp.maximum(
+                jnp.arange(s0, dtype=jnp.int32)[None, :] - pad[:, None], 0)
+            last_h, caches = self._prefill(params, ids, total,
+                                           mask=valid.astype(jnp.int32),
+                                           pos_ids=pos_ids)
+        else:
+            pad = jnp.zeros((b,), jnp.int32)
+            last_h, caches = self._prefill(params, input_ids, total)
         first_logits = self.lm_logits(params, last_h[:, None])[:, 0]
 
         def pick(logits, step_rng):
             if temperature <= 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = self._filter_logits(logits / temperature, top_k,
+                                         top_p)
             return jax.random.categorical(
-                step_rng, logits / temperature, axis=-1).astype(jnp.int32)
+                step_rng, scaled, axis=-1).astype(jnp.int32)
 
-        tok0 = pick(first_logits,
-                    jax.random.fold_in(rng, 0) if rng is not None else None)
+        def step_rng(step):
+            return (jax.random.fold_in(rng, step)
+                    if rng is not None else None)
 
-        def body(carry, step):
-            caches, tok, pos = carry
-            logits, caches = self._decode_step(params, caches, tok, pos)
-            nxt = pick(logits,
-                       jax.random.fold_in(rng, step + 1)
-                       if rng is not None else None)
-            return (caches, nxt, pos + 1), tok
+        tok0 = pick(first_logits, step_rng(0))
 
-        (_, last_tok, _), toks = lax.scan(
-            body, (caches, tok0, jnp.int32(s0)),
-            jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
-        # toks carries tokens 0..max_new-2 (each body emits its INPUT
-        # token); the final pick is appended explicitly
-        out = jnp.concatenate([toks.transpose(1, 0), last_tok[:, None]],
-                              axis=1)
+        if eos_id is None:
+            def body(carry, step):
+                caches, tok, pos = carry
+                logits, caches = self._decode_step(params, caches, tok,
+                                                   pos, pad)
+                nxt = pick(logits, step_rng(step + 1))
+                return (caches, nxt, pos + 1), tok
+
+            (_, last_tok, _), toks = lax.scan(
+                body, (caches, tok0, jnp.int32(s0)),
+                jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+            # toks carries tokens 0..max_new-2 (each body emits its
+            # INPUT token); the final pick is appended explicitly
+            return jnp.concatenate([toks.transpose(1, 0),
+                                    last_tok[:, None]], axis=1)
+
+        # EOS early-stop: while_loop emits into a preallocated buffer
+        # and exits as soon as every row is done — a batch whose rows
+        # all finish by step k pays k+1 decode steps, not max_new
+        out0 = jnp.full((b, max_new_tokens), pad_id, jnp.int32)
+
+        def cond(carry):
+            _, _, _, done, _, t = carry
+            return (t < max_new_tokens) & jnp.logical_not(jnp.all(done))
+
+        def wbody(carry):
+            caches, tok, pos, done, out, t = carry
+            emit = jnp.where(done, pad_id, tok)
+            out = lax.dynamic_update_slice_in_dim(out, emit[:, None], t,
+                                                  axis=1)
+            done = done | (tok == eos_id)
+
+            # the decode step computes the NEXT token — skip it when no
+            # next slot will be emitted (last iteration, or every row
+            # just finished), matching the scan path's
+            # one-decode-per-emitted-token cost
+            def dec(caches, tok, pos):
+                logits, caches = self._decode_step(params, caches, tok,
+                                                   pos, pad)
+                return pick(logits, step_rng(t + 1)), caches
+
+            nxt, caches = lax.cond(
+                (t + 1 < max_new_tokens) & jnp.logical_not(jnp.all(done)),
+                dec, lambda caches, tok, pos: (tok, caches),
+                caches, tok, pos)
+            return (caches, nxt, pos + 1, done, out, t + 1)
+
+        carry = (caches, tok0, jnp.int32(s0),
+                 jnp.zeros((b,), bool), out0, jnp.int32(0))
+        _, _, _, _, out, _ = lax.while_loop(cond, wbody, carry)
         return out
 
     # ------------------------------------------------------------------

@@ -148,32 +148,37 @@ def export_model(model, params, extras, out_dir: str, *,
 def export_generator(model, params, out_dir: str, *,
                      prompt_len: int, max_new_tokens: int,
                      batch_size: int = 1, temperature: float = 0.0,
+                     top_k: int = 0, top_p: float = 0.0,
+                     eos_id: int | None = None, pad_id: int = 0,
+                     ragged: bool = False,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
-    """Serialize ``model.generate`` (params baked, greedy or fixed-
-    temperature sampling) as a self-contained decode artifact: the whole
-    generation — prefill + the KV-cache ``lax.scan`` — is ONE StableHLO
-    program mapping ``{"input_ids": [B, prompt_len]}`` (plus ``"rng"``
-    when sampling) to ``[B, max_new_tokens]`` token ids. Static shapes
-    throughout (the decode loop's cache layout depends on prompt and
-    generation lengths, so the artifact is inherently static-shape; the
-    metadata records it as such)."""
+    """Serialize ``model.generate`` (params baked; greedy or
+    temperature/top-k/top-p sampling, optional EOS early-stop) as a
+    self-contained decode artifact: the whole generation — prefill +
+    the KV-cache decode loop — is ONE StableHLO program mapping
+    ``{"input_ids": [B, prompt_len]}`` (plus ``"rng"`` when sampling,
+    plus ``"prompt_mask"`` when ``ragged``) to ``[B, max_new_tokens]``
+    token ids. Static shapes throughout (the decode loop's cache layout
+    depends on prompt and generation lengths, so the artifact is
+    inherently static-shape; the metadata records it as such)."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
 
     sampled = temperature > 0.0
-    if sampled:
-        def serve(feats):
-            return model.generate(params, feats["input_ids"],
-                                  max_new_tokens,
-                                  temperature=temperature,
-                                  rng=jax.random.wrap_key_data(
-                                      feats["rng"]))
-    else:
-        def serve(feats):
-            return model.generate(params, feats["input_ids"],
-                                  max_new_tokens)
+
+    def serve(feats):
+        return model.generate(
+            params, feats["input_ids"], max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, pad_id=pad_id,
+            prompt_mask=feats.get("prompt_mask"),
+            rng=(jax.random.wrap_key_data(feats["rng"])
+                 if sampled else None))
 
     features = {"input_ids": np.zeros((batch_size, prompt_len), np.int32)}
+    if ragged:
+        features["prompt_mask"] = np.ones((batch_size, prompt_len),
+                                          np.int32)
     if sampled:
         features["rng"] = np.zeros(
             np.shape(jax.random.key_data(jax.random.key(0))), np.uint32)
@@ -186,7 +191,9 @@ def export_generator(model, params, out_dir: str, *,
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
                            max_new_tokens=max_new_tokens,
-                           temperature=temperature)
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, eos_id=eos_id, pad_id=pad_id,
+                           ragged=ragged)
 
 
 class ServableModel:

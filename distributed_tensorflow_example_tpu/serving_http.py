@@ -12,8 +12,19 @@ shape:
     {"inputs": {"x": [[...], ...]}}             # columnar format
     -> {"predictions": [[...], ...]}
 
+    POST /v1/models/<name>:generate              # generator artifacts
+    {"inputs": {"input_ids": [[...], ...]}, "seed": 7}
+    -> {"generations": [[token ids], ...]}
+
     GET /v1/models/<name>                        # status probe
     -> {"model_version_status": [{"state": "AVAILABLE", ...}]}
+
+``:generate`` serves :func:`~.serving.export_generator` artifacts (the
+whole KV-cache decode is inside the StableHLO program); the ``rng`` of
+a sampling artifact is synthesized server-side from the integer
+``seed``, and ragged artifacts additionally take a ``prompt_mask``
+feature. A generator artifact rejects ``:predict`` (and vice versa)
+with a 400 naming the right route.
 
 Batch-polymorphic artifacts (the export default) serve any instance
 count; static-batch artifacts (the MoE fallback) serve any count UP TO
@@ -37,6 +48,15 @@ import numpy as np
 from .serving import ServableModel, load_servable
 
 
+class _ServerFault(Exception):
+    """Wraps an exception raised by the EXECUTABLE (platform mismatch,
+    runtime OOM, ...) so the HTTP layer can answer 500 even when the
+    underlying type is ValueError/TypeError — the client-fault types the
+    request-validation path maps to 400. jax.export's call raises
+    ValueError for a served-on-wrong-platform artifact; without the
+    wrapper that server-side failure would be blamed on the client."""
+
+
 class PredictServer:
     """Serve one exported model directory over HTTP.
 
@@ -56,8 +76,10 @@ class PredictServer:
         self._thread: threading.Thread | None = None
 
     # -- request plumbing ----------------------------------------------
-    def _feature_arrays(self, payload: dict) -> dict[str, np.ndarray]:
-        sig = self.servable.input_signature
+    def _feature_arrays(self, payload: dict,
+                        sig: dict | None = None) -> dict[str, np.ndarray]:
+        if sig is None:
+            sig = self.servable.input_signature
         if "instances" in payload:
             rows = payload["instances"]
             if not isinstance(rows, list) or not rows:
@@ -99,6 +121,12 @@ class PredictServer:
             raise ValueError(
                 f"inputs disagree on instance count: {sorted(counts)}")
         n = counts.pop()
+        if n == 0:
+            # np.repeat(v[:1], ...) on an empty array still yields 0
+            # rows, so the static-batch pad below would hand the
+            # executable an empty batch and the client would see an
+            # opaque 500 — reject the empty request as the 400 it is
+            raise ValueError("request contains zero instances")
         if not self.servable.meta.get("batch_polymorphic", True):
             # static-batch artifact (e.g. MoE fallback): pad up to the
             # exported batch and let predict() truncate — only MORE
@@ -126,11 +154,58 @@ class PredictServer:
                     for k, v in out.items()}
         return out, n
 
+    def _execute(self, feats) -> np.ndarray:
+        try:
+            return np.asarray(self.servable(feats))
+        except Exception as e:
+            raise _ServerFault(f"{type(e).__name__}: {e}") from e
+
     def predict(self, payload: dict) -> dict:
+        if self.servable.meta.get("kind") == "generator":
+            raise ValueError(
+                "this artifact is a generator — POST to :generate")
         feats, n = self._feature_arrays(payload)
-        logits = np.asarray(self.servable(feats))
+        logits = self._execute(feats)
         # truncate any server-side padding back to the client's count
         return {"predictions": logits[:n].tolist()}
+
+    def generate(self, payload: dict) -> dict:
+        """The decode route: ``{"inputs": {"input_ids": [[...]], ...},
+        "seed": 7}`` -> ``{"generations": [[token ids]]}``. The ``rng``
+        artifact input (present when the artifact samples) is NOT a
+        per-instance feature — it is synthesized server-side from the
+        request's integer ``seed`` (default 0), so clients never handle
+        raw PRNG key data."""
+        if self.servable.meta.get("kind") != "generator":
+            raise ValueError(
+                "this artifact is not a generator — POST to :predict "
+                "(export with export_generator for a decode artifact)")
+        sig = {k: v for k, v in self.servable.input_signature.items()
+               if k != "rng"}
+        feats, n = self._feature_arrays(payload, sig)
+        pm = feats.get("prompt_mask")
+        if pm is not None and not np.all(np.sum(pm != 0, axis=1) > 0):
+            # an all-masked row would prefill over an empty key set and
+            # return arbitrary tokens with a 200 (generate's own check
+            # can't run — the mask is traced inside the exported
+            # program); the server holds the concrete mask, so it rejects
+            raise ValueError(
+                "every prompt_mask row needs at least one real token")
+        if "rng" in self.servable.input_signature:
+            import jax
+            seed = payload.get("seed", 0)
+            # bool is an int subclass (true would silently mean seed 1),
+            # and an out-of-int64 value would blow up as OverflowError
+            # inside jax.random.key — a 500 for what is client input
+            if isinstance(seed, bool) or not isinstance(seed, int) \
+                    or not -(2 ** 63) <= seed < 2 ** 63:
+                raise ValueError(
+                    f"'seed' must be an int64-range integer, got "
+                    f"{seed!r}")
+            feats["rng"] = np.asarray(
+                jax.random.key_data(jax.random.key(seed)))
+        toks = self._execute(feats)
+        return {"generations": toks[:n].tolist()}
 
     def _make_handler(self):
         server = self
@@ -162,7 +237,12 @@ class PredictServer:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
-                if self.path != f"/v1/models/{server.name}:predict":
+                routes = {f"/v1/models/{server.name}:predict":
+                          server.predict,
+                          f"/v1/models/{server.name}:generate":
+                          server.generate}
+                route = routes.get(self.path)
+                if route is None:
                     self._send(404, {"error": f"unknown path {self.path}"})
                     return
                 try:
@@ -179,18 +259,16 @@ class PredictServer:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    feats, count = server._feature_arrays(payload)
-                except (ValueError, KeyError, TypeError) as e:
-                    self._send(400, {"error": str(e)})  # client's fault
-                    return
-                try:
-                    logits = np.asarray(server.servable(feats))
-                    # static-batch artifacts were padded server-side:
-                    # return only the client's rows
-                    self._send(200, {"predictions": logits[:count].tolist()})
-                except Exception as e:                  # server's fault:
+                    self._send(200, route(payload))
+                except _ServerFault as e:               # executable died:
                     # platform mismatch, runtime OOM, ... must be a 500,
                     # not a dropped connection or a client-blaming 400
+                    # (predict/generate wrap execution so even a
+                    # ValueError from the runtime stays a server fault)
+                    self._send(500, {"error": str(e)})
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(400, {"error": str(e)})  # client's fault
+                except Exception as e:
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
         return Handler

@@ -289,7 +289,13 @@ class PreemptionHook(Hook):
         self.stop_requested = False   # a prior run's stop must not leak
                                       # into a resumed train() call
         self._multiprocess = jax.process_count() > 1
-        self._last_polled = None
+        # seed the poll window from the run's start step: with
+        # steps_per_loop > 1 the first after_step sees step ==
+        # start+K, and starting the poll AT that boundary would skip
+        # ids start+1..start+K-1 — exactly the unpolled gap the loop
+        # below exists to close (a SIGTERM during the first loop could
+        # set the sync point inside it and the stop would never fire)
+        self._last_polled = int(getattr(trainer, "start_step", 0) or 0)
         if self._multiprocess:
             # SIGTERM belongs to the TSL preemption notifier here; a
             # Python handler would steal the signal from the cross-host
@@ -330,8 +336,8 @@ class PreemptionHook(Hook):
             # advances K at a time, so poll every id in the gap or the
             # safe step could fall between observed boundaries and the
             # stop would silently never fire
-            start = (int(step) if self._last_polled is None
-                     else self._last_polled + 1)
+            start = (self._last_polled + 1 if self._last_polled is not None
+                     else int(step))   # None only if begin() never ran
             for s in range(start, int(step) + 1):
                 if multihost_utils.reached_preemption_sync_point(s):
                     log.warning("preemption sync point at step %d: all "

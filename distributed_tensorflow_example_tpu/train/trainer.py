@@ -294,6 +294,17 @@ class Trainer:
         timing = self.config.obs.step_timing
         want_aot = timing
         self.last_dispatch_ms: float | None = None
+        # --max_inflight_steps: bound the async dispatch queue. JAX
+        # queues dispatches without waiting; N big steps in flight is
+        # normally free pipelining, but a runtime that misbehaves under
+        # deep queues (round-4 tunnel INVALID_ARGUMENT on the long-
+        # context causal program — BASELINE.md) gets a first-class cap
+        # instead of a hand-rolled workaround
+        max_inflight = self.config.max_inflight_steps
+        if max_inflight < 0:
+            raise ValueError(f"max_inflight_steps must be >= 0, got "
+                             f"{max_inflight}")
+        pending = 0
         try:
             # begin() inside the try: a failing begin (or anything after a
             # partial begin) must still run every hook's end() — hooks
@@ -304,6 +315,7 @@ class Trainer:
             loader = self._loader()
             while not stop:
                 remaining = self.config.train_steps - step
+                step_before = step
                 if spl > 1 and remaining >= spl:
                     # K steps per dispatch (iterations_per_loop analogue):
                     # stack K host batches on a leading loop axis and scan
@@ -328,6 +340,11 @@ class Trainer:
                 if timing:
                     jax.block_until_ready(state.params)
                     self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
+                elif max_inflight:
+                    pending += step - step_before
+                    if pending >= max_inflight:
+                        jax.block_until_ready(state.params)
+                        pending = 0
                 self.state = state
 
                 wants = any(h.wants_metrics(step) for h in self.hooks)

@@ -572,3 +572,43 @@ def test_cosine_and_linear_end_at_absolute_total_steps():
         warmup_steps=20))
     assert float(lin(100)) == pytest.approx(0.0, abs=1e-9)
     assert float(lin(60)) == pytest.approx(0.2, rel=1e-5)   # midpoint
+
+
+def test_max_inflight_steps_bounds_the_dispatch_queue(cpu8, monkeypatch):
+    """max_inflight_steps=N blocks the host every N trained steps (the
+    documented mitigation for runtimes that misbehave under deep
+    dispatch queues); 0 never blocks mid-loop; negative is a hard
+    error. Counted by intercepting jax.block_until_ready."""
+    from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                           MeshShape)
+    from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist
+    from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+    data = synthetic_mnist(num_train=256, num_test=32, seed=0)
+
+    def run(max_inflight, steps=6):
+        cfg = TrainConfig(
+            model="mlp", train_steps=steps, mesh=MeshShape(data=4),
+            max_inflight_steps=max_inflight,
+            data=DataConfig(batch_size=32, seed=1),
+            optimizer=OptimizerConfig(name="sgd", learning_rate=0.1))
+        model = get_model("mlp", cfg)
+        t = Trainer(model, cfg, {"x": data["train_x"],
+                                 "y": data["train_y"]},
+                    mesh=local_mesh(4), process_index=0, num_processes=1)
+        calls = []
+        real = jax.block_until_ready
+        monkeypatch.setattr(jax, "block_until_ready",
+                            lambda x: (calls.append(1), real(x))[1])
+        with t:
+            t.train()
+        monkeypatch.setattr(jax, "block_until_ready", real)
+        return len(calls)
+
+    free = run(0)          # blocks only at loop exit (+ eval-free end)
+    every2 = run(2)        # + one block per 2 trained steps
+    every1 = run(1)
+    assert every2 >= free + 3, (free, every2)
+    assert every1 >= free + 6, (free, every1)
+    with pytest.raises(ValueError, match="max_inflight_steps"):
+        run(-1)

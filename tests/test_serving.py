@@ -229,3 +229,36 @@ def test_generator_artifact_round_trip(tmp_path):
     np.testing.assert_array_equal(
         t1, np.asarray(m.generate(params, prompt, 6, temperature=0.8,
                                   rng=jax.random.key(7))))
+
+
+def test_generator_artifact_with_eos_topk_ragged(tmp_path):
+    """The full knob surface survives export: a ragged top-k sampling
+    artifact with EOS early-stop reproduces the live generate call
+    with identical knobs (rng via raw key data)."""
+    from distributed_tensorflow_example_tpu.serving import export_generator
+    import jax.numpy as jnp
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    params, _ = _init(m)
+    rs = np.random.RandomState(1)
+    ids = rs.randint(1, 1000, (2, 8), dtype=np.int32)
+    mask = np.asarray([[1] * 8, [1] * 5 + [0] * 3], np.int32)
+    ids[1, 5:] = 0
+    free = np.asarray(m.generate(params, jnp.asarray(ids), 6,
+                                 prompt_mask=jnp.asarray(mask)))
+    eos = int(free[0, 2])
+
+    d = str(tmp_path / "gen")
+    export_generator(m, params, d, prompt_len=8, max_new_tokens=6,
+                     batch_size=2, temperature=0.9, top_k=50,
+                     eos_id=eos, pad_id=-7, ragged=True,
+                     platforms=("cpu",))
+    sv = load_servable(d)
+    assert sv.meta["ragged"] and sv.meta["eos_id"] == eos
+    key = jax.random.key_data(jax.random.key(11))
+    got = np.asarray(sv({"input_ids": jnp.asarray(ids),
+                         "prompt_mask": jnp.asarray(mask), "rng": key}))
+    want = np.asarray(m.generate(params, jnp.asarray(ids), 6,
+                                 temperature=0.9, top_k=50, eos_id=eos,
+                                 pad_id=-7, prompt_mask=jnp.asarray(mask),
+                                 rng=jax.random.key(11)))
+    np.testing.assert_array_equal(got, want)

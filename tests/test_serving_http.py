@@ -121,6 +121,19 @@ def test_server_fault_is_500_not_400(servable_dir):
         assert e.value.code == 500
         assert "backend exploded" in json.loads(e.value.read())["error"]
 
+        # a ValueError FROM THE EXECUTABLE (jax.export raises ValueError
+        # for a wrong-platform artifact) is still the server's fault —
+        # it must not fall into the client-fault 400 bucket
+        class BoomVE(Boom):
+            def __call__(self, f):
+                raise ValueError("platform mismatch")
+
+        srv.servable = BoomVE()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name, {"inputs": {"x": x.tolist()}})
+        assert e.value.code == 500
+        assert "platform mismatch" in json.loads(e.value.read())["error"]
+
 
 def test_multi_input_model_over_rest(tmp_path):
     """BERT-family servables take several feature keys per instance —
@@ -166,22 +179,144 @@ def test_static_artifact_serves_any_count_up_to_batch(tmp_path):
                      for k, v in feats.items()}
             got = _post(srv.port, srv.name, {"inputs": short})
             assert len(got["predictions"]) == n
-            # row i of a padded request is computed on the same padded
-            # batch layout only for row content; routing capacity is
-            # per-batch, so compare against a fresh full-batch run of
-            # the SAME first-row padding, i.e. self-consistency: resend
-            # and expect identical output (deterministic executable)
-            again = _post(srv.port, srv.name, {"inputs": short})
-            assert got == again
+            # the real claim (ADVICE r4): row i of the truncated
+            # response equals row i of the LIVE model applied to the
+            # batch the server actually built — first n real rows,
+            # padded to B by repeating row 0 (a deterministic-but-wrong
+            # pad/truncate would pass a resend-self-consistency check;
+            # it cannot pass an independent oracle)
+            padded = {k: np.concatenate(
+                [np.asarray(v)[:n],
+                 np.repeat(np.asarray(v)[:1], 4 - n, axis=0)])
+                for k, v in feats.items()}
+            want_n = np.asarray(
+                m.apply(params, extras, padded, train=False)[0])[:n]
+            np.testing.assert_allclose(
+                np.asarray(got["predictions"]), want_n,
+                rtol=1e-5, atol=1e-5)
         over = {k: np.concatenate([np.asarray(v)] * 2).tolist()
                 for k, v in feats.items()}
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(srv.port, srv.name, {"inputs": over})
         assert e.value.code == 400
         assert "static batch" in json.loads(e.value.read())["error"]
+        # zero instances: the pad path would hand the static executable
+        # an EMPTY batch (np.repeat of v[:1] on 0 rows is still 0 rows)
+        # — must be rejected as a client fault, not surface as a 500
+        empty = {k: np.asarray(v)[:0].tolist() for k, v in feats.items()}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name, {"inputs": empty})
+        assert e.value.code == 400   # JSON [] loses the tail shape, so
+        # the per-instance shape check 400s it; the n == 0 guard itself
+        # is reached when the tail shape survives (shaped empty arrays):
+        with pytest.raises(ValueError, match="zero instances"):
+            srv._feature_arrays(
+                {"inputs": {k: np.asarray(v)[:0] for k, v in feats.items()}})
         # inputs disagreeing on instance count are a 400 too
         bad = {k: np.asarray(v)[: 1 + i].tolist()
                for i, (k, v) in enumerate(feats.items())}
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(srv.port, srv.name, {"inputs": bad})
         assert e.value.code == 400
+
+
+def _post_verb(port, name, verb, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:{verb}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_generate_route_round_trip(tmp_path):
+    """REST :generate over a generator artifact: greedy tokens match the
+    live generate; a sampled artifact takes an integer seed (server
+    synthesizes the rng input) and is deterministic per seed; the wrong
+    route on each artifact kind is a clear 400."""
+    from distributed_tensorflow_example_tpu.serving import export_generator
+    import jax.numpy as jnp
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    out = m.init(jax.random.key(0))
+    params = out[0] if isinstance(out, tuple) else out
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 1000, (2, 8), dtype=np.int32)
+
+    d = str(tmp_path / "greedy")
+    export_generator(m, params, d, prompt_len=8, max_new_tokens=5,
+                     batch_size=2, platforms=("cpu",))
+    with PredictServer(d) as srv:
+        got = _post_verb(srv.port, srv.name, "generate",
+                         {"inputs": {"input_ids": ids.tolist()}})
+        want = np.asarray(m.generate(params, jnp.asarray(ids), 5))
+        np.testing.assert_array_equal(np.asarray(got["generations"]), want)
+        # a 1-row request rides the static-batch pad/truncate path
+        one = _post_verb(srv.port, srv.name, "generate",
+                         {"inputs": {"input_ids": ids[:1].tolist()}})
+        np.testing.assert_array_equal(np.asarray(one["generations"]),
+                                      want[:1])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name,
+                  {"inputs": {"input_ids": ids.tolist()}})
+        assert e.value.code == 400
+        assert ":generate" in json.loads(e.value.read())["error"]
+
+    d2 = str(tmp_path / "sampled")
+    export_generator(m, params, d2, prompt_len=8, max_new_tokens=5,
+                     batch_size=2, temperature=0.8, top_p=0.95,
+                     platforms=("cpu",))
+    with PredictServer(d2) as srv:
+        a = _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist()}, "seed": 3})
+        b = _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist()}, "seed": 3})
+        c = _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist()}, "seed": 4})
+        assert a == b
+        assert a != c
+        want = np.asarray(m.generate(params, jnp.asarray(ids), 5,
+                                     temperature=0.8, top_p=0.95,
+                                     rng=jax.random.key(3)))
+        np.testing.assert_array_equal(np.asarray(a["generations"]), want)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist()},
+                        "seed": "not-an-int"})
+        assert e.value.code == 400
+
+
+def test_predict_artifact_rejects_generate_route(servable_dir):
+    d, feats, _ = servable_dir
+    with PredictServer(d) as srv:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"x": np.asarray(feats["x"]).tolist()}})
+        assert e.value.code == 400
+        assert ":predict" in json.loads(e.value.read())["error"]
+
+
+def test_generate_ragged_rejects_all_masked_row(tmp_path):
+    """A prompt_mask row with zero real tokens would decode garbage with
+    a 200 (the in-model check cannot run on a traced mask); the server
+    holds the concrete mask and must 400 it."""
+    from distributed_tensorflow_example_tpu.serving import export_generator
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    out = m.init(jax.random.key(0))
+    params = out[0] if isinstance(out, tuple) else out
+    d = str(tmp_path / "ragged")
+    export_generator(m, params, d, prompt_len=6, max_new_tokens=3,
+                     batch_size=2, ragged=True, platforms=("cpu",))
+    ids = np.zeros((2, 6), np.int32)
+    good = np.asarray([[1, 1, 1, 0, 0, 0], [1, 1, 0, 0, 0, 0]])
+    bad = np.asarray([[1, 1, 1, 0, 0, 0], [0, 0, 0, 0, 0, 0]])
+    with PredictServer(d) as srv:
+        ok = _post_verb(srv.port, srv.name, "generate",
+                        {"inputs": {"input_ids": ids.tolist(),
+                                    "prompt_mask": good.tolist()}})
+        assert np.asarray(ok["generations"]).shape == (2, 3)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist(),
+                                   "prompt_mask": bad.tolist()}})
+        assert e.value.code == 400
+        assert "real token" in json.loads(e.value.read())["error"]
