@@ -2,7 +2,7 @@
 """Benchmark suite: sync-replica training throughput on the driver metric.
 
 The driver-defined headline metric (BASELINE.json:2) is examples/sec/chip
-on MNIST + ResNet-50; this suite measures three workloads on whatever
+on MNIST + ResNet-50; this suite measures nine workloads on whatever
 devices are present (the driver runs it on one real TPU chip):
 
 - ``mnist_mlp``   — the reference-parity workload (BASELINE.json:7)
@@ -10,10 +10,17 @@ devices are present (the driver runs it on one real TPU chip):
 - ``bert_base``   — MLM step time, seq 128 (BASELINE.json:11)
 - ``moe_bert``    — expert-parallel flagship, 8 experts top-1, b64
 - ``bert_large``  — the big dense model, b64
-- ``bert_long``   — composed long context: S=4096 flash + remat=full, b4
+- ``bert_long``   — composed long context: S=4096 flash, b4 (remat=none
+  since the round-5 sweep — BASELINE.md "Round-5 remat sweep")
+- ``gpt_small``   — causal-LM train, s512 b32 (VERDICT r4 task #2)
+- ``gpt_long``    — causal long context: S=4096 causal flash + chunked
+  LM loss, b4 (queued-dispatch methodology like bert_long — the round-4
+  reliability defect is resolved, BASELINE.md GPT row)
+- ``gpt_decode``  — KV-cache greedy decode, b8 prompt 128 + 128 new;
+  tokens/s/chip via the one-dispatch compiled generation
 
-The last three are this repo's own flagship capabilities (VERDICT r3
-task #3): a regression in any of the six moves ``vs_baseline``.
+Eight are training throughput, one is decode; a regression in ANY of
+the nine moves ``vs_baseline``.
 
 For each, an MFU estimate = XLA-reported FLOPs for the compiled step /
 measured step time / chip peak (bf16) is recorded. The reference publishes
@@ -184,6 +191,74 @@ def _dummy_batch(model, batch, i):
     return model.dummy_batch(batch)
 
 
+def _gpt_batch_at(seq: int):
+    """Causal-LM batch maker at a fixed sequence length (dummy_batch
+    caps at 128, and the model's max_len can exceed the workload's seq
+    — gpt keeps max_len >= 1024)."""
+    def make(model, batch, i):
+        s = min(seq, model.cfg.max_len)
+        rs = np.random.RandomState(i)
+        return {
+            "input_ids": rs.randint(0, model.cfg.vocab_size, (batch, s),
+                                    dtype=np.int32),
+            "attention_mask": np.ones((batch, s), np.int32),
+        }
+    return make
+
+
+def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
+                warmup: int, tiny: bool):
+    """tokens/s/chip for the compiled-scan KV-cache generation. The
+    whole generation is ONE dispatch on ONE device; each of the
+    ``reps`` generations is synchronously drained via device_get (see
+    the timing note below — nothing is queued, so the number
+    conservatively includes the per-call dispatch/sync overhead; the
+    baseline was recorded with the same method). Returns
+    (tokens_per_s_chip, token_step_ms, None, suspect)."""
+    import functools
+
+    from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.models.base import cast_floating
+    import jax.numpy as jnp
+
+    name = "gpt_tiny" if tiny else "gpt"
+    cfg = TrainConfig(model=name, dtype="bfloat16",
+                      param_dtype="bfloat16",
+                      data=DataConfig(batch_size=batch))
+    model = get_model(name, cfg)
+    params = cast_floating(model.init(jax.random.key(0)), jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (batch, prompt),
+                                 dtype=np.int32))
+    gen = jax.jit(functools.partial(model.generate,
+                                    max_new_tokens=max_new))
+    # time via device_get of the tokens, NOT block_until_ready: through
+    # the axon tunnel block_until_ready returns in ~0.1 ms for this
+    # program without the work having run (measured round 5 — every
+    # queued/blocked variant read 100-1000x faster than the weight-
+    # traffic bound), while the host transfer cannot complete before
+    # the computation has. The [B, max_new] int32 transfer is ~4 KB —
+    # negligible against a ~10^2 ms generation.
+    np.asarray(gen(params, ids))
+    for _ in range(warmup):
+        np.asarray(gen(params, ids))
+
+    def timed_pass():
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = np.asarray(gen(params, ids))
+        return time.perf_counter() - t0
+
+    dt, suspect = robust_time(timed_pass, steps=reps)
+    per_gen = dt / reps
+    # per-chip = the whole number: the generation is a single-device
+    # jit (no mesh), so dividing by the host's visible device count
+    # would under-report on any multi-device host
+    return (batch * max_new / per_gen,
+            per_gen / max_new * 1e3, None, suspect)
+
+
 def _long_batch(model, batch, i):
     """BERT batch at the model's FULL configured sequence length
     (dummy_batch caps at 128 for the seq-128 workloads)."""
@@ -255,8 +330,35 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
              warmup=2 if on_tpu else 1, opt=adamw,
              make_batch=_long_batch,
              extra_cfg={"seq_len": 4096 if on_tpu else 256},
-             cfg_over={"attention_impl": "flash", "remat": "full"},
+             # remat=none since round 5: 36% faster at this shape and
+             # fits in ~8.4 GiB of 16 (BASELINE.md "Round-5 remat
+             # sweep"; baseline re-based with a methodology note)
+             cfg_over={"attention_impl": "flash", "remat": "none"},
              prng_impl=rbg, eps_digits=2),
+        dict(key="gpt_small", only={"gpt", "gpt_small"},
+             model="gpt" if on_tpu else "gpt_tiny",
+             batch=max(8, 32 // scale), steps=20 if on_tpu else 2,
+             warmup=5 if on_tpu else 1, opt=adamw,
+             make_batch=_gpt_batch_at(512 if on_tpu else 128),
+             # chunk=0: the free 2% at b32 where the full logits fit
+             # (BASELINE.md GPT profile); --lm_loss_chunk remains the
+             # bigger-shape enabler
+             extra_cfg={"seq_len": 512 if on_tpu else 128},
+             prng_impl=rbg),
+        dict(key="gpt_long", only={"gpt_long"},
+             model="gpt" if on_tpu else "gpt_tiny",
+             batch=4 if on_tpu else 2, steps=8 if on_tpu else 1,
+             warmup=2 if on_tpu else 1, opt=adamw,
+             make_batch=_gpt_batch_at(4096 if on_tpu else 128),
+             extra_cfg={"seq_len": 4096 if on_tpu else 128},
+             cfg_over={"attention_impl": "flash", "remat": "none",
+                       "lm_loss_chunk": 512 if on_tpu else 64},
+             prng_impl=rbg, eps_digits=2),
+        dict(key="gpt_decode", only={"gpt_decode", "decode"},
+             decode=dict(batch=8, prompt=128 if on_tpu else 16,
+                         max_new=128 if on_tpu else 8,
+                         reps=4 if on_tpu else 1,
+                         warmup=2 if on_tpu else 0, tiny=not on_tpu)),
     ]
 
 
@@ -277,8 +379,13 @@ def vs_baseline_geomean(extra: dict, base: dict) -> float:
                    ("bert_base_eps_chip", base.get("bert_base_eps_chip")),
                    ("moe_bert_eps_chip", base.get("moe_bert_eps_chip")),
                    ("bert_large_eps_chip", base.get("bert_large_eps_chip")),
-                   ("bert_long_eps_chip", base.get("bert_long_eps_chip"))):
-        if extra.get(key.replace("_eps_chip", "_suspect")):
+                   ("bert_long_eps_chip", base.get("bert_long_eps_chip")),
+                   ("gpt_small_eps_chip", base.get("gpt_small_eps_chip")),
+                   ("gpt_long_eps_chip", base.get("gpt_long_eps_chip")),
+                   ("gpt_decode_tokens_s_chip",
+                    base.get("gpt_decode_tokens_s_chip"))):
+        if extra.get(key.replace("_eps_chip", "_suspect")
+                     .replace("_tokens_s_chip", "_suspect")):
             continue
         if extra.get(key) and b:
             ratios.append(extra[key] / b)
@@ -306,6 +413,13 @@ def main() -> None:
         if only is not None and not (w["only"] & set(only)):
             continue
         key = w["key"]
+        if "decode" in w:
+            tps, ms, mfu, suspect = _run_decode(**w["decode"])
+            extra[f"{key}_tokens_s_chip"] = round(tps)
+            extra[f"{key}_token_step_ms"] = round(ms, 3)
+            if suspect:
+                extra[f"{key}_suspect"] = True
+            continue
         eps, ms, mfu, suspect = _run(
             w["model"], batch=w["batch"], steps=w["steps"],
             warmup=w["warmup"], opt=w["opt"],

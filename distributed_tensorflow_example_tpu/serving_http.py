@@ -106,6 +106,14 @@ class PredictServer:
         if missing:
             raise ValueError(f"missing model inputs {sorted(missing)} "
                              f"(want {sorted(sig)})")
+        unknown = set(cols) - set(sig)
+        if unknown:
+            # a silently dropped feature is worse than an error: e.g. a
+            # prompt_mask POSTed to a generator exported WITHOUT
+            # ragged=True would otherwise be discarded and the pad ids
+            # decoded as real prompt tokens, 200 OK
+            raise ValueError(f"unknown model inputs {sorted(unknown)} "
+                             f"(this artifact takes {sorted(sig)})")
         out = {}
         counts = set()
         for key, spec in sig.items():

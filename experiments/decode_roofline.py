@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Autoregressive decode roofline + lever sweep (VERDICT r4 task #3).
+
+Round 4 measured KV-cache greedy decode at 1.55 ms/token-step (b8,
+prompt 128 + 128 new, GPT-small) — ~5x above the naive weight-traffic
+bound (~0.25 GB of bf16 params re-read per token-step / 819 GB/s ~=
+0.31 ms). This script measures the decode step against that bound and
+runs the candidate levers:
+
+  batch   — b in {1, 8, 16, 32, 64}: weight reads amortize over rows,
+            so tokens/s/chip should scale until something else binds
+  newlen  — max_new in {32, 128, 256} at b8: cache length T = prompt +
+            new grows attention/DUS traffic; measures its slope
+  trace   — jax.profiler capture of one generation dispatch, reduced
+            with utils.trace_summary (committed as PROFILE_r05_decode)
+
+Each cell is a fresh process (axon-tunnel timing lesson, round 4);
+prints one JSON line per cell. Numbers + verdicts live in BASELINE.md.
+
+Usage: python experiments/decode_roofline.py batch 8
+       python experiments/decode_roofline.py newlen 256
+       python experiments/decode_roofline.py trace /tmp/decode_trace
+       python experiments/decode_roofline.py --all
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROMPT = 128
+
+
+def _build(batch: int):
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.models.base import cast_floating
+    import jax.numpy as jnp
+
+    cfg = TrainConfig(model="gpt", dtype="bfloat16",
+                      param_dtype="bfloat16",
+                      data=DataConfig(batch_size=batch))
+    model = get_model("gpt", cfg)
+    params = cast_floating(model.init(jax.random.key(0)), jnp.bfloat16)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.data.vocab_size, (batch, PROMPT),
+                                 dtype=np.int32))
+    return model, params, ids
+
+
+def measure(batch: int, max_new: int, *, reps=8, warmup=2) -> dict:
+    import jax
+
+    model, params, ids = _build(batch)
+    gen = jax.jit(functools.partial(model.generate,
+                                    max_new_tokens=max_new))
+    import numpy as np
+
+    np.asarray(gen(params, ids))
+    for _ in range(warmup):
+        np.asarray(gen(params, ids))
+
+    n_param = sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
+    bound_ms = n_param * 2 / 819e9 * 1e3      # bf16 weights read once
+
+    def timed():
+        # device_get the tokens each rep, NOT block_until_ready: through
+        # the axon tunnel block_until_ready returns in ~0.1 ms for this
+        # program WITHOUT the work having run (measured: every blocked/
+        # queued variant read 100-1000x faster than the weight-traffic
+        # bound; device_get cannot return before the computation). The
+        # [B, max_new] int32 transfer is ~KBs — negligible.
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(gen(params, ids))
+        return time.perf_counter() - t0
+
+    dt = max(timed(), timed()) / reps
+    for _ in range(4):
+        if dt / max_new * 1e3 >= bound_ms * 0.5:
+            break       # physically plausible (vs the weight floor)
+        dt = max(timed(), timed()) / reps
+    token_step_ms = dt / max_new * 1e3
+    return {
+        "batch": batch, "prompt": PROMPT, "max_new": max_new,
+        "gen_ms": round(dt * 1e3, 1),
+        "token_step_ms": round(token_step_ms, 3),
+        "tokens_per_s_chip": round(batch * max_new / dt),
+        # naive bound: every param (bf16) read once per token-step
+        "weight_bound_ms": round(bound_ms, 3),
+        "params_M": round(n_param / 1e6, 1),
+        "suspect": token_step_ms < bound_ms * 0.5,
+    }
+
+
+def trace(outdir: str) -> dict:
+    import jax
+
+    model, params, ids = _build(8)
+    gen = jax.jit(functools.partial(model.generate, max_new_tokens=128))
+    jax.block_until_ready(gen(params, ids))      # compile outside trace
+    jax.profiler.start_trace(outdir)
+    jax.block_until_ready(gen(params, ids))
+    jax.profiler.stop_trace()
+    return {"trace": outdir}
+
+
+def main() -> None:
+    if sys.argv[1:2] == ["--all"]:
+        env = dict(os.environ,
+                   DTX_JAX_CACHE=os.environ.get("DTX_JAX_CACHE",
+                                                "/tmp/dtx_jax_cache"))
+        me = os.path.abspath(__file__)
+        for b in (1, 8, 16, 32, 64):
+            subprocess.run([sys.executable, me, "batch", str(b)],
+                           env=env, check=False)
+        for n in (32, 256):
+            subprocess.run([sys.executable, me, "newlen", str(n)],
+                           env=env, check=False)
+        return
+    mode, arg = sys.argv[1], sys.argv[2]
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("DTX_JAX_CACHE", "/tmp/dtx_jax_cache"))
+    try:
+        if mode == "batch":
+            out = measure(int(arg), 128)
+        elif mode == "newlen":
+            out = measure(8, int(arg))
+        elif mode == "trace":
+            out = trace(arg)
+        else:
+            raise SystemExit(f"unknown mode {mode!r}")
+        print(json.dumps(out), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"mode": mode, "arg": arg,
+                          "error": f"{type(e).__name__}: {str(e)[:200]}"}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

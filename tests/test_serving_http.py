@@ -320,3 +320,18 @@ def test_generate_ragged_rejects_all_masked_row(tmp_path):
                                    "prompt_mask": bad.tolist()}})
         assert e.value.code == 400
         assert "real token" in json.loads(e.value.read())["error"]
+
+
+def test_unknown_inputs_are_400(servable_dir):
+    """An input key the artifact does not take must be rejected, not
+    silently dropped — e.g. a prompt_mask POSTed to a non-ragged
+    generator would otherwise be discarded and garbage decoded with a
+    200."""
+    d, feats, _ = servable_dir
+    with PredictServer(d) as srv:
+        x = np.asarray(feats["x"])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name,
+                  {"inputs": {"x": x.tolist(), "prompt_mask": [[1]]}})
+        assert e.value.code == 400
+        assert "unknown model inputs" in json.loads(e.value.read())["error"]
