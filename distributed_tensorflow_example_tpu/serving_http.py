@@ -91,7 +91,17 @@ class PredictServer:
                         f"this one takes {sorted(sig)}")
                 only = next(iter(sig))
                 rows = [{only: r} for r in rows]
-            cols = {k: [r[k] for r in rows] for k in rows[0]}
+            keys = set(rows[0])
+            for i, r in enumerate(rows):
+                if not isinstance(r, dict) or set(r) != keys:
+                    # a key present only in LATER rows would silently
+                    # vanish from the column build below — the exact
+                    # dropped-feature failure the unknown-input check
+                    # exists to reject
+                    raise ValueError(
+                        f"instance {i} keys {sorted(r) if isinstance(r, dict) else type(r).__name__} "
+                        f"differ from instance 0 keys {sorted(keys)}")
+            cols = {k: [r[k] for r in rows] for k in keys}
         elif "inputs" in payload:
             cols = payload["inputs"]
             if not isinstance(cols, dict):

@@ -101,10 +101,27 @@ def main(argv=None) -> int:
         state.params, prompt)
     sampled = model.generate(state.params, prompt, args.new_tokens,
                              temperature=0.8, rng=jax.random.key(0))
+    # nucleus sampling with EOS early-stop: the serving-style call —
+    # top_p keeps the smallest high-probability token set, eos_id stops
+    # a row the moment it emits that token (pad_id fills the tail), and
+    # the whole thing is still one compiled dispatch
+    eos = int(np.asarray(greedy)[0, args.new_tokens // 2])
+    nucleus = model.generate(state.params, prompt, args.new_tokens,
+                             temperature=0.8, top_p=0.9, eos_id=eos,
+                             pad_id=-1, rng=jax.random.key(1))
+    # ragged prompts: row 1 uses only half its prompt (prompt_mask is
+    # right-padded per row); generation continues each row from ITS
+    # real tokens — parity with per-row dense decode is test-asserted
+    pmask = np.ones(prompt.shape, np.int32)
+    pmask[1, args.prompt_len // 2:] = 0
+    ragged = model.generate(state.params, prompt, args.new_tokens,
+                            prompt_mask=jnp.asarray(pmask))
     for b in range(prompt.shape[0]):
         print(f"prompt : {np.asarray(prompt)[b].tolist()}")
         print(f"greedy : {np.asarray(greedy)[b].tolist()}")
         print(f"sampled: {np.asarray(sampled)[b].tolist()}")
+        print(f"nucleus(eos={eos}): {np.asarray(nucleus)[b].tolist()}")
+        print(f"ragged : {np.asarray(ragged)[b].tolist()}")
     return 0
 
 

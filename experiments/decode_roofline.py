@@ -28,7 +28,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -57,47 +56,24 @@ def _build(batch: int):
 
 
 def measure(batch: int, max_new: int, *, reps=8, warmup=2) -> dict:
-    import jax
+    # ONE decode-measurement implementation: bench.py's _run_decode
+    # (device_get timing + weight-floor retry + suspect flag) — the
+    # experiment and the gate must never measure two different ways
+    # (that divergence is how the round-4 1.55 ms and the artifacted
+    # 0.001 ms readings coexisted)
+    from bench import _run_decode
 
-    model, params, ids = _build(batch)
-    gen = jax.jit(functools.partial(model.generate,
-                                    max_new_tokens=max_new))
-    import numpy as np
-
-    np.asarray(gen(params, ids))
-    for _ in range(warmup):
-        np.asarray(gen(params, ids))
-
-    n_param = sum(int(p.size) for p in jax.tree_util.tree_leaves(params))
-    bound_ms = n_param * 2 / 819e9 * 1e3      # bf16 weights read once
-
-    def timed():
-        # device_get the tokens each rep, NOT block_until_ready: through
-        # the axon tunnel block_until_ready returns in ~0.1 ms for this
-        # program WITHOUT the work having run (measured: every blocked/
-        # queued variant read 100-1000x faster than the weight-traffic
-        # bound; device_get cannot return before the computation). The
-        # [B, max_new] int32 transfer is ~KBs — negligible.
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            np.asarray(gen(params, ids))
-        return time.perf_counter() - t0
-
-    dt = max(timed(), timed()) / reps
-    for _ in range(4):
-        if dt / max_new * 1e3 >= bound_ms * 0.5:
-            break       # physically plausible (vs the weight floor)
-        dt = max(timed(), timed()) / reps
-    token_step_ms = dt / max_new * 1e3
+    tps, token_step_ms, bound_ms, suspect = _run_decode(
+        batch=batch, prompt=PROMPT, max_new=max_new, reps=reps,
+        warmup=warmup, tiny=False)
     return {
         "batch": batch, "prompt": PROMPT, "max_new": max_new,
-        "gen_ms": round(dt * 1e3, 1),
+        "gen_ms": round(token_step_ms * max_new, 1),
         "token_step_ms": round(token_step_ms, 3),
-        "tokens_per_s_chip": round(batch * max_new / dt),
+        "tokens_per_s_chip": round(tps),
         # naive bound: every param (bf16) read once per token-step
         "weight_bound_ms": round(bound_ms, 3),
-        "params_M": round(n_param / 1e6, 1),
-        "suspect": token_step_ms < bound_ms * 0.5,
+        "suspect": suspect,
     }
 
 

@@ -124,7 +124,12 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("DTX_JAX_CACHE", "/tmp/dtx_jax_cache"))
     try:
-        out = timed_cell(int(arg)) if mode == "time" else trace(arg)
+        if mode == "time":
+            out = timed_cell(int(arg))
+        elif mode == "trace":
+            out = trace(arg)
+        else:
+            raise SystemExit(f"unknown mode {mode!r}")
         print(json.dumps(out), flush=True)
     except Exception as e:  # noqa: BLE001 — OOM at compile is a finding
         print(json.dumps({"mode": mode, "arg": arg,
