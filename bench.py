@@ -214,7 +214,7 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     the timing note below — nothing is queued, so the number
     conservatively includes the per-call dispatch/sync overhead; the
     baseline was recorded with the same method). Returns
-    (tokens_per_s_chip, token_step_ms, None, suspect)."""
+    (tokens_per_s_chip, token_step_ms, weight_bound_ms, suspect)."""
     import functools
 
     from distributed_tensorflow_example_tpu.config import (DataConfig,

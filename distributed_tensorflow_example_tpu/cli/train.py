@@ -41,6 +41,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mlp | pipe_mlp | lenet | resnet20 | resnet50 | "
                         "bert | bert_large | bert_tiny | moe_bert | "
                         "moe_bert_tiny | pipe_bert | pipe_bert_tiny | "
+                        "pipe_moe_bert | pipe_moe_bert_tiny | "
                         "gpt | gpt_tiny")
     p.add_argument("--dataset", default=None,
                    help="default: the model's canonical dataset")
@@ -530,7 +531,8 @@ def load_dataset(cfg: TrainConfig, model=None, eval_only: bool = False):
                            synthetic=cfg.data.synthetic)
     elif name in ("bert", "bert_large", "bert_tiny",
                   "moe_bert", "moe_bert_tiny",
-                  "pipe_bert", "pipe_bert_tiny"):
+                  "pipe_bert", "pipe_bert_tiny",
+                  "pipe_moe_bert", "pipe_moe_bert_tiny"):
         from ..data.bert_data import get_bert_data
         # take vocab/prediction shapes from the MODEL so data and logits
         # can never diverge (out-of-range labels clamp silently under jit)
@@ -657,10 +659,12 @@ def main(argv: list[str] | None = None) -> int:
                       ("--moe_aux_weight", args.moe_aux_weight),
                       ("--moe_router_z_weight", args.moe_router_z_weight),
                       ("--moe_jitter", args.moe_jitter)):
-        if val is not None and not args.model.startswith("moe_"):
+        if val is not None and not (args.model.startswith("moe_")
+                            or args.model.startswith("pipe_moe_")):
             raise SystemExit(
-                f"{flag} is an MoE routing knob (moe_bert/"
-                f"moe_bert_tiny), not for model {args.model!r}")
+                f"{flag} is an MoE routing knob (moe_bert/moe_bert_tiny/"
+                f"pipe_moe_bert/pipe_moe_bert_tiny), not for "
+                f"model {args.model!r}")
 
     cluster = None
     if args.ps_hosts or args.worker_hosts:

@@ -10,8 +10,8 @@ from . import resnet as resnet    # registers "resnet20", "resnet50"
 from . import bert as bert        # registers "bert", "bert_tiny"
 from . import moe as moe          # registers "moe_bert", "moe_bert_tiny"
 from . import pipe_mlp as pipe_mlp  # registers "pipe_mlp"
-from . import pipe_bert as pipe_bert  # registers "pipe_bert",
-                                      # "pipe_bert_tiny"
+from . import pipe_bert as pipe_bert  # registers "pipe_bert"(+_tiny)
+from . import pipe_moe as pipe_moe  # registers "pipe_moe_bert"(+_tiny)
 from . import gpt as gpt          # registers "gpt", "gpt_tiny"
 
 __all__ = ["Model", "get_model", "list_models", "register_model"]

@@ -208,6 +208,63 @@ def moe_ffn(params: Params, x: jax.Array, *, n_experts: int, top_k: int = 1,
     return out.reshape(b, s, d).astype(x.dtype), aux
 
 
+def moe_ffn_ep_body(p_local: Params, x_local: jax.Array, *,
+                    n_experts: int, n_ranks: int, top_k: int,
+                    capacity_factor: float, dtype,
+                    axis_name: str, stat_axes,
+                    model_axis: str | None = None,
+                    rng: jax.Array | None = None,
+                    jitter: float = 0.0) -> tuple[jax.Array, dict]:
+    """The per-member EP dataflow — call INSIDE an active ``shard_map``
+    whose ``axis_name`` axis shards tokens and expert weights (and whose
+    ``stat_axes`` shard tokens). :func:`moe_ffn_shard_map` wraps it; the
+    pipelined MoE model (EP×PP) calls it per stage tick. One
+    implementation, every composition.
+
+    ``x_local``: [B, S, D] — this member's token shard. ``p_local``'s
+    expert arrays are the local [e_local, ...] slices. Returns
+    (y_local, aux) with aux computed from stats pmean'd over
+    ``stat_axes`` (global-batch values; the lb formula is nonlinear, so
+    it must see the pmean'd stats)."""
+    e_local = n_experts // n_ranks
+    bl, sl, dl = x_local.shape
+    tl = bl * sl
+    x2 = x_local.reshape(tl, dl)
+    cap = capacity_for(tl, n_experts, capacity_factor)
+    lrng = rng
+    if lrng is not None:
+        # independent noise per token shard: fold in EVERY axis the
+        # tokens are sharded over, not just the expert rank
+        for ax in stat_axes:
+            lrng = jax.random.fold_in(lrng, lax.axis_index(ax))
+    dispatch, combine, stats = _route(p_local["router"], x2,
+                                      n_experts, top_k, cap,
+                                      rng=lrng, jitter=jitter)
+    send = jnp.einsum("tec,td->ecd", dispatch.astype(dtype),
+                      x2.astype(dtype),
+                      preferred_element_type=jnp.float32)   # [E, C, D]
+    # exchange: chunk j of the expert dim goes to rank j; rank r then
+    # holds, source-rank-major, every rank's buffers for ITS experts
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    # regroup [n_ranks · e_local, C, D] -> [e_local, n_ranks · C, D]
+    recv = recv.reshape(n_ranks, e_local, cap, dl).transpose(1, 0, 2, 3)
+    recv = recv.reshape(e_local, n_ranks * cap, dl)
+    out = _expert_compute(
+        {k: v for k, v in p_local.items() if k != "router"},
+        recv, dtype, psum_axis=model_axis)                  # [e_l, nC, D]
+    # send results back: invert the regrouping then all_to_all again
+    back = out.reshape(e_local, n_ranks, cap, dl).transpose(1, 0, 2, 3)
+    back = back.reshape(n_ranks * e_local, cap, dl)
+    got = lax.all_to_all(back.astype(jnp.float32), axis_name,
+                          split_axis=0, concat_axis=0, tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32), got)
+    gstats = jax.tree_util.tree_map(
+        lambda v: lax.pmean(v, stat_axes), stats)
+    aux = _aux_pack(gstats, n_experts, top_k, tl, cap)
+    return y.reshape(bl, sl, dl).astype(x_local.dtype), aux
+
+
 def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
                       n_experts: int, top_k: int = 1,
                       capacity_factor: float = 1.25, dtype=jnp.float32,
@@ -255,48 +312,11 @@ def moe_ffn_shard_map(params: Params, x: jax.Array, mesh, *,
     e_local = n_experts // n_ranks
 
     def body(p_local, x_local):
-        # x_local: [B, S/n, D] — this rank's token shard; p_local's expert
-        # arrays are the local [e_local, ...] slices (sharded by in_specs)
-        bl, sl, dl = x_local.shape
-        tl = bl * sl
-        x2 = x_local.reshape(tl, dl)
-        cap = capacity_for(tl, n_experts, capacity_factor)
-        lrng = rng
-        if lrng is not None:
-            # independent noise per token shard: fold in EVERY axis the
-            # tokens are sharded over, not just the expert rank
-            for ax in stat_axes:
-                lrng = jax.random.fold_in(lrng, lax.axis_index(ax))
-        dispatch, combine, stats = _route(p_local["router"], x2,
-                                          n_experts, top_k, cap,
-                                          rng=lrng, jitter=jitter)
-        send = jnp.einsum("tec,td->ecd", dispatch.astype(dtype),
-                          x2.astype(dtype),
-                          preferred_element_type=jnp.float32)   # [E, C, D]
-        # exchange: chunk j of the expert dim goes to rank j; rank r then
-        # holds, source-rank-major, every rank's buffers for ITS experts
-        recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
-                              tiled=True)
-        # regroup [n_ranks · e_local, C, D] -> [e_local, n_ranks · C, D]
-        recv = recv.reshape(n_ranks, e_local, cap, dl).transpose(1, 0, 2, 3)
-        recv = recv.reshape(e_local, n_ranks * cap, dl)
-        out = _expert_compute(
-            {k: v for k, v in p_local.items() if k != "router"},
-            recv, dtype, psum_axis=model_axis)               # [e_l, nC, D]
-        # send results back: invert the regrouping then all_to_all again
-        back = out.reshape(e_local, n_ranks, cap, dl).transpose(1, 0, 2, 3)
-        back = back.reshape(n_ranks * e_local, cap, dl)
-        got = lax.all_to_all(back.astype(jnp.float32), axis_name,
-                             split_axis=0, concat_axis=0, tiled=True)
-        y = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32), got)
-        # global-batch aux: pmean the statistics over every axis the
-        # tokens are sharded on, then apply the formulas (equal-size
-        # token shards make pmean == the global batch mean; the lb
-        # formula is nonlinear, so it must see the pmean'd stats)
-        gstats = jax.tree_util.tree_map(
-            lambda v: lax.pmean(v, stat_axes), stats)
-        aux = _aux_pack(gstats, n_experts, top_k, tl, cap)
-        return y.reshape(bl, sl, dl).astype(x_local.dtype), aux
+        return moe_ffn_ep_body(
+            p_local, x_local, n_experts=n_experts, n_ranks=n_ranks,
+            top_k=top_k, capacity_factor=capacity_factor, dtype=dtype,
+            axis_name=axis_name, stat_axes=stat_axes,
+            model_axis=model_axis, rng=rng, jitter=jitter)
 
     xspec = P(batch_axes, axis_name, None)
     tp = model_axis

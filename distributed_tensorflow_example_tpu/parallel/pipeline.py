@@ -176,6 +176,14 @@ def sequential_blocks(stage_fn: StageFn, stacked_params, x,
     same per-microbatch split so mb-indexed randomness matches. Used as
     the pipe-axis-absent fallback and as the parity target in tests."""
     b = jax.tree_util.tree_leaves(x)[0].shape[0]
+    if not isinstance(b, int):
+        # batch-polymorphic trace (jax.export symbolic dim): the
+        # microbatch split depends concretely on the batch size —
+        # raise the same family MoE capacity math does so the
+        # exporter's static-batch fallback engages (serving.py)
+        raise TypeError(
+            f"microbatch split needs a concrete batch size, got "
+            f"symbolic {b!r}")
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by "
                          f"num_microbatches={num_microbatches}")

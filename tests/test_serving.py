@@ -27,7 +27,8 @@ def _init(model):
 
 @pytest.mark.parametrize("name", ["mlp", "lenet", "bert_tiny",
                                   "moe_bert_tiny",
-                                  "pipe_bert_tiny"])
+                                  "pipe_bert_tiny",
+                                  "pipe_moe_bert_tiny"])
 def test_export_roundtrip_matches_live_forward(name, tmp_path):
     cfg = TrainConfig(model=name)
     m = get_model(name, cfg)
