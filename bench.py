@@ -17,7 +17,11 @@ devices are present (the driver runs it on one real TPU chip):
   LM loss, b4 (queued-dispatch methodology like bert_long — the round-4
   reliability defect is resolved, BASELINE.md GPT row)
 - ``gpt_decode``  — KV-cache greedy decode, b8 prompt 128 + 128 new;
-  tokens/s/chip via the one-dispatch compiled generation
+  tokens/s/chip via the one-dispatch compiled generation, riding the
+  stacked-scan decode fast path (models/gpt.py decode_impl="stacked":
+  lax.scan over restacked layer params, fused QKV, single-query Pallas
+  cache attention on TPU); timed as the median of >=5 repeats
+  (median_repeats) so the row's spread is published and < ±2%
 
 Eight are training throughput, one is decode; a regression in ANY of
 the nine moves ``vs_baseline``.
@@ -115,6 +119,40 @@ def robust_time(timed_pass, *, steps: int, flops=None, peak=None,
     return dt, bool(bad)
 
 
+def median_repeats(timed_single, *, reps: int, floor_s: float | None = None,
+                   retries: int = 3) -> tuple[float, float, bool]:
+    """Median-of-repeats timing for the decode gate row (seconds).
+
+    The decode wall-clock carries ~100 ms/call of tunnel overhead
+    (~50% of the measurement — BASELINE.md decode roofline), so a
+    max-of-two estimate let tunnel jitter move the gate row ±5%
+    (VERDICT r5 weak #4). ``timed_single`` times ONE generation; this
+    takes the MEDIAN of ``reps`` such timings — robust to both the
+    absurdly-fast tunnel artifact (a corrupt low outlier cannot become
+    the median while most repeats are honest) and slow dispatch
+    hiccups. Retries the whole sample while the median sits below
+    ``floor_s`` (the physically-impossible bound, e.g. half the
+    weight-traffic floor); ``suspect=True`` if it never recovers.
+
+    Returns ``(median_s, spread, suspect)`` where ``spread`` is the
+    max relative deviation of any repeat from the median — the
+    publishable ±noise figure the gate row's < ±2% target is judged
+    by.
+    """
+    if reps < 1:
+        raise ValueError(f"reps must be >= 1, got {reps}")
+    med = spread = 0.0
+    suspect = False
+    for attempt in range(retries):
+        ts = sorted(timed_single() for _ in range(reps))
+        med = ts[(len(ts) - 1) // 2]
+        spread = max(abs(t - med) for t in ts) / med if med > 0 else 0.0
+        suspect = floor_s is not None and med < floor_s
+        if not suspect:
+            break
+    return med, spread, suspect
+
+
 def _run(model_name: str, *, batch: int, steps: int, warmup: int,
          opt: OptimizerConfig, make_batch, extra_cfg: dict | None = None,
          cfg_over: dict | None = None,
@@ -207,14 +245,17 @@ def _gpt_batch_at(seq: int):
 
 
 def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
-                warmup: int, tiny: bool):
-    """tokens/s/chip for the compiled-scan KV-cache generation. The
-    whole generation is ONE dispatch on ONE device; each of the
-    ``reps`` generations is synchronously drained via device_get (see
-    the timing note below — nothing is queued, so the number
-    conservatively includes the per-call dispatch/sync overhead; the
-    baseline was recorded with the same method). Returns
-    (tokens_per_s_chip, token_step_ms, weight_bound_ms, suspect)."""
+                warmup: int, tiny: bool, gen_kwargs: dict | None = None):
+    """tokens/s/chip for the compiled KV-cache generation (the stacked
+    fast path by default; ``gen_kwargs`` overrides decode_impl /
+    decode_attention / tokens_per_dispatch / weight_quant for the
+    lever sweep in experiments/decode_roofline.py). The whole
+    generation is ONE dispatch on ONE device, each repeat synchronously
+    drained via device_get (see the timing note below). The published
+    number is the MEDIAN of ``reps`` per-generation timings after
+    warmup (median_repeats — the de-noised gate methodology; spread is
+    the row's published ±noise). Returns (tokens_per_s_chip,
+    token_step_ms, weight_bound_ms, spread, suspect)."""
     import functools
 
     from distributed_tensorflow_example_tpu.config import (DataConfig,
@@ -232,7 +273,8 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     ids = jnp.asarray(rs.randint(0, model.cfg.vocab_size, (batch, prompt),
                                  dtype=np.int32))
     gen = jax.jit(functools.partial(model.generate,
-                                    max_new_tokens=max_new))
+                                    max_new_tokens=max_new,
+                                    **(gen_kwargs or {})))
     # time via device_get of the tokens, NOT block_until_ready: through
     # the axon tunnel block_until_ready returns in ~0.1 ms for this
     # program without the work having run (measured round 5 — every
@@ -249,26 +291,22 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     n_param = sum(int(p.size)
                   for p in jax.tree_util.tree_leaves(params))
     bound_ms = n_param * 2 / 819e9 * 1e3
+    on_tpu = jax.devices()[0].platform == "tpu"
 
-    def timed_pass():
+    def timed_single():
         t0 = time.perf_counter()
-        for _ in range(reps):
-            out = np.asarray(gen(params, ids))
+        np.asarray(gen(params, ids))
         return time.perf_counter() - t0
 
-    dt, suspect = robust_time(timed_pass, steps=reps)
-    for _ in range(3):
-        if suspect or dt / reps / max_new * 1e3 >= bound_ms * 0.5:
-            break
-        dt, suspect = robust_time(timed_pass, steps=reps)
-    if dt / reps / max_new * 1e3 < bound_ms * 0.5:
-        suspect = True          # still physically impossible
-    per_gen = dt / reps
+    per_gen, spread, suspect = median_repeats(
+        timed_single, reps=reps,
+        # off-TPU the bf16 weight bound is meaningless (no 819 GB/s HBM)
+        floor_s=(bound_ms * 0.5 * max_new / 1e3) if on_tpu else None)
     # per-chip = the whole number: the generation is a single-device
     # jit (no mesh), so dividing by the host's visible device count
     # would under-report on any multi-device host
     return (batch * max_new / per_gen,
-            per_gen / max_new * 1e3, bound_ms, suspect)
+            per_gen / max_new * 1e3, bound_ms, spread, suspect)
 
 
 def _long_batch(model, batch, i):
@@ -366,10 +404,14 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
              cfg_over={"attention_impl": "flash", "remat": "none",
                        "lm_loss_chunk": 512 if on_tpu else 64},
              prng_impl=rbg, eps_digits=2),
+        # reps=7: median-of-repeats de-noising (VERDICT r5 weak #4) —
+        # odd count gives a true middle element, 7 keeps the row under
+        # ~2 s of measurement while the median shrugs off single-call
+        # tunnel jitter; decode rides the stacked fast path by default
         dict(key="gpt_decode", only={"gpt_decode", "decode"},
              decode=dict(batch=8, prompt=128 if on_tpu else 16,
                          max_new=128 if on_tpu else 8,
-                         reps=4 if on_tpu else 1,
+                         reps=7 if on_tpu else 1,
                          warmup=2 if on_tpu else 0, tiny=not on_tpu)),
     ]
 
@@ -426,10 +468,11 @@ def main() -> None:
             continue
         key = w["key"]
         if "decode" in w:
-            tps, ms, bound_ms, suspect = _run_decode(**w["decode"])
+            tps, ms, bound_ms, spread, suspect = _run_decode(**w["decode"])
             extra[f"{key}_tokens_s_chip"] = round(tps)
             extra[f"{key}_token_step_ms"] = round(ms, 3)
             extra[f"{key}_weight_bound_ms"] = round(bound_ms, 3)
+            extra[f"{key}_spread"] = round(spread, 4)
             if suspect:
                 extra[f"{key}_suspect"] = True
             continue

@@ -80,15 +80,23 @@ class GPT:
 
     def __init__(self, cfg: GPTConfig, dtype=jnp.float32,
                  attention_impl: str = "xla", attention_fn=None,
-                 param_dtype=jnp.float32, remat: str = "none"):
+                 param_dtype=jnp.float32, remat: str = "none",
+                 decode_attention_impl: str = "auto"):
         assert cfg.hidden % cfg.heads == 0
         if remat != "none" and remat not in REMAT_POLICIES:
             raise ValueError(f"remat must be one of "
                              f"{['none', *REMAT_POLICIES]}, got {remat!r}")
+        if decode_attention_impl not in ("auto", "pallas", "xla"):
+            raise ValueError(f"decode_attention_impl must be auto/pallas/"
+                             f"xla, got {decode_attention_impl!r}")
         self.cfg = cfg
         self.dtype = dtype
         self.param_dtype = param_dtype
         self.attention_impl = attention_impl
+        # decode fast path: single-query Pallas attention over the cache
+        # slab ("auto" = kernel on TPU at tile-friendly shapes, XLA
+        # otherwise; see ops/pallas/decode_attention.py)
+        self.decode_attention_impl = decode_attention_impl
         # sequence parallelism: pass make_ring_attention(mesh, causal=True)
         # — the ring schedule's causal block masking (global q/k offsets
         # per hop) makes the sharded result equal the single-device
@@ -376,13 +384,155 @@ class GPT:
         h = nn.layernorm(params["ln_f"], h)
         return self.lm_logits(params, h)[:, 0], new_caches
 
+    # ------------------------------------------------------------------
+    # decode fast path: stacked layer axis + lax.scan + fused QKV
+    # ------------------------------------------------------------------
+    def stack_decode_params(self, params, *, weight_quant: str | None = None):
+        """Restack the per-layer param dicts into ONE pytree with a
+        leading layer axis, with the Q/K/V projections fused into a
+        single [hid, 3*hid] kernel per layer. The decode layer loop then
+        runs as ``lax.scan`` over this stack: one traced layer body
+        instead of ``layers`` unrolled copies, and one fat QKV matmul
+        per layer instead of three skinny ones — the kernel-count floor
+        attack PROFILE_r05_decode motivates.
+
+        ``weight_quant="int8"`` additionally stores the four matmul
+        kernels as symmetric per-output-channel int8 (scale = f32 row
+        max / 127), halving decode weight traffic for the stacked
+        layers. LOSSY: greedy parity with the bf16 path is NOT
+        guaranteed — it exists as the decode lever table's int8
+        comparison row. Embeddings / LM head / layernorms stay in
+        ``param_dtype``.
+
+        Cost note: ``generate`` restacks INSIDE the compiled program,
+        once per generation (``params`` is a runtime argument to the
+        caller's jit, so XLA cannot constant-fold it) — one extra
+        param read+write against the ``max_new`` weight re-reads of
+        the decode loop, <2% of a 128-token generation's traffic and
+        paid identically by every lever row except ``loop``. Exported
+        artifacts bake params as constants, so there the restack
+        folds away at trace time.
+        """
+        if weight_quant not in (None, "int8"):
+            raise ValueError(f"weight_quant must be None or 'int8', got "
+                             f"{weight_quant!r}")
+        c = self.cfg
+        lps = [params[f"layer_{i}"] for i in range(c.layers)]
+
+        def stk(fn):
+            return jnp.stack([fn(lp) for lp in lps])
+
+        def dense_stack(fn):
+            d = {"kernel": stk(lambda lp: fn(lp)["kernel"]),
+                 "bias": stk(lambda lp: fn(lp)["bias"])}
+            if weight_quant == "int8":
+                w = d.pop("kernel").astype(jnp.float32)
+                scale = jnp.maximum(
+                    jnp.max(jnp.abs(w), axis=1, keepdims=True), 1e-8) / 127.0
+                d["kernel_q"] = jnp.round(w / scale).astype(jnp.int8)
+                d["scale"] = scale
+            return d
+
+        return {
+            "ln1": {"scale": stk(lambda lp: lp["ln1"]["scale"]),
+                    "bias": stk(lambda lp: lp["ln1"]["bias"])},
+            "qkv": dense_stack(lambda lp: {
+                "kernel": jnp.concatenate(
+                    [lp["attn"][n]["kernel"] for n in ("q", "k", "v")],
+                    axis=1),
+                "bias": jnp.concatenate(
+                    [lp["attn"][n]["bias"] for n in ("q", "k", "v")])}),
+            "o": dense_stack(lambda lp: lp["attn"]["o"]),
+            "ln2": {"scale": stk(lambda lp: lp["ln2"]["scale"]),
+                    "bias": stk(lambda lp: lp["ln2"]["bias"])},
+            "ffn_in": dense_stack(lambda lp: lp["ffn"]["in"]),
+            "ffn_out": dense_stack(lambda lp: lp["ffn"]["out"]),
+        }
+
+    def _dequant(self, dp):
+        """int8-stacked dense params -> plain {kernel, bias} (no-op for
+        unquantized stacks). Runs INSIDE the layer scan body, so the
+        int8 tensors are what crosses HBM per layer step."""
+        if "kernel_q" not in dp:
+            return dp
+        w = (dp["kernel_q"].astype(jnp.float32) * dp["scale"])
+        return {"kernel": w.astype(self.dtype), "bias": dp["bias"]}
+
+    def _decode_step_stacked(self, params, stacked, caches, tok, pos,
+                             pad=None, decode_attention: str | None = None):
+        """One-token forward as ONE ``lax.scan`` over the stacked layer
+        axis. Same contract as :meth:`_decode_step` (exact greedy
+        parity is tier-1-tested), but the per-token program is the
+        compact fast path: fused QKV, 2-D [B, hid] residual stream (no
+        singleton seq axis to re-tile), and the cache-slab attention as
+        either the single-query Pallas kernel or the XLA reference.
+
+        ``caches``: ``{"k": [L, B, T, H, D], "v": [L, B, T, H, D]}`` —
+        the per-layer slabs stacked along the scan axis.
+        """
+        from ..ops.pallas.decode_attention import (decode_attention as
+                                                   decode_attn)
+        c = self.cfg
+        b = tok.shape[0]
+        impl = decode_attention or self.decode_attention_impl
+        if pad is None:
+            pad = jnp.zeros((b,), jnp.int32)
+        h, _ = self._embed(params, tok[:, None], (pos - pad)[:, None],
+                           rng=None, train=False)
+        h = h[:, 0]                                       # [B, hid]
+
+        def body(h, xs):
+            lp, ck, cv = xs
+            qkv = nn.dense(self._dequant(lp["qkv"]),
+                           nn.layernorm(lp["ln1"], h), dtype=self.dtype)
+            q, k, v = [x.reshape(b, c.heads, self.head_dim)
+                       for x in jnp.split(qkv, 3, axis=-1)]
+            ck = lax.dynamic_update_slice(
+                ck, k[:, None].astype(ck.dtype), (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v[:, None].astype(cv.dtype), (0, pos, 0, 0))
+            ctx = decode_attn(q, ck, cv, pos=pos, pad=pad, impl=impl)
+            a = nn.dense(self._dequant(lp["o"]), ctx.reshape(b, c.hidden),
+                         dtype=self.dtype)
+            h = h + a.astype(h.dtype)
+            f = nn.dense(self._dequant(lp["ffn_in"]),
+                         nn.layernorm(lp["ln2"], h), dtype=self.dtype)
+            f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+            f = nn.dense(self._dequant(lp["ffn_out"]), f, dtype=self.dtype)
+            h = h + f.astype(h.dtype)
+            return h, (ck, cv)
+
+        h, (ks, vs) = lax.scan(body, h,
+                               (stacked, caches["k"], caches["v"]))
+        h = nn.layernorm(params["ln_f"], h)
+        return (self.lm_logits(params, h[:, None])[:, 0],
+                {"k": ks, "v": vs})
+
+    def _stack_caches(self, caches):
+        """Per-layer {layer_i: {k, v}} prefill caches -> the stacked
+        {"k": [L, ...], "v": [L, ...]} slabs the scan step consumes."""
+        c = self.cfg
+        return {n: jnp.stack([caches[f"layer_{i}"][n]
+                              for i in range(c.layers)])
+                for n in ("k", "v")}
+
     def _filter_logits(self, logits, top_k: int, top_p: float):
         """Nucleus/top-k filtering of [B, V] (temperature-scaled)
         logits: everything outside the kept set drops to the shared
         NEG_INF fill (exp underflows to exactly 0 under categorical).
         top-p keeps the smallest prefix of the descending-probability
         order whose EXCLUSIVE cumulative mass is < top_p — the top token
-        always survives."""
+        always survives.
+
+        Tie behavior (deliberate, ``>=``-threshold semantics): only
+        logits STRICTLY below the kth-largest / nucleus-threshold value
+        are dropped, so every token exactly TIED with the boundary
+        survives — top_k can keep more than k tokens and top-p more
+        than the nucleus mass on exact ties. Ties at the boundary are
+        measure-zero in f32 practice; when they do occur, keeping both
+        is the symmetric choice (dropping would need an arbitrary
+        vocab-order preference). Covered by the tied-logits unit tests
+        in tests/test_gpt.py."""
         from ..ops.attention import NEG_INF
         if top_k:
             kth = lax.top_k(logits, top_k)[0][:, -1:]
@@ -400,10 +550,32 @@ class GPT:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: int | None = None,
                  pad_id: int = 0, prompt_mask=None,
-                 rng: jax.Array | None = None):
+                 rng: jax.Array | None = None,
+                 decode_impl: str = "stacked",
+                 decode_attention: str | None = None,
+                 tokens_per_dispatch: int = 1,
+                 weight_quant: str | None = None):
         """Autoregressive generation — one compiled program (prefill +
         KV-cache decode loop), greedy (``temperature=0``) or sampled
         with optional ``top_k``/``top_p`` (nucleus) filtering.
+
+        ``decode_impl`` picks the decode-step body: ``"stacked"`` (the
+        default fast path — layer loop as ``lax.scan`` over restacked
+        leading-axis params with fused QKV; greedy output is exactly
+        the ``"loop"`` path's, tier-1-tested) or ``"loop"`` (the
+        reference per-layer Python loop). ``decode_attention``
+        overrides the model's ``decode_attention_impl`` for the stacked
+        path (``"auto"``/``"pallas"``/``"xla"``).
+
+        ``tokens_per_dispatch=K`` emits K tokens per decode-loop body
+        (``lax.scan``'s unroll) so fixed per-iteration overhead
+        amortizes across K token steps; output is exactly the K=1
+        token stream. Requires ``eos_id=None`` (the early-stop
+        ``while_loop`` has a dynamic trip count — nothing to unroll).
+
+        ``weight_quant="int8"`` decodes against int8-quantized stacked
+        layer weights (see :meth:`stack_decode_params`) — LOSSY, the
+        lever-table comparison row, stacked path only.
 
         ``prompt_mask`` [B, S0] (1 = real token) admits RAGGED prompt
         batches: real tokens (left-aligned by convention; any layout is
@@ -445,6 +617,27 @@ class GPT:
         if top_k < 0 or top_k > c.vocab_size:
             raise ValueError(f"top_k must be in [0, vocab_size="
                              f"{c.vocab_size}], got {top_k}")
+        if decode_impl not in ("stacked", "loop"):
+            raise ValueError(f"decode_impl must be 'stacked' or 'loop', "
+                             f"got {decode_impl!r}")
+        if tokens_per_dispatch < 1:
+            raise ValueError(f"tokens_per_dispatch must be >= 1, got "
+                             f"{tokens_per_dispatch}")
+        if tokens_per_dispatch > 1 and eos_id is not None:
+            raise ValueError(
+                "tokens_per_dispatch > 1 needs eos_id=None: the EOS "
+                "early-stop while_loop has a dynamic trip count, so "
+                "there is no fixed K-step body to unroll")
+        if weight_quant is not None and decode_impl != "stacked":
+            raise ValueError("weight_quant needs decode_impl='stacked' "
+                             "(only the stacked scan consumes the "
+                             "quantized layer stack)")
+        if decode_attention is not None and decode_impl != "stacked":
+            raise ValueError(
+                "decode_attention picks the stacked path's cache-slab "
+                "attention; decode_impl='loop' always uses the XLA "
+                "reference — silently ignoring the override would "
+                "mislabel a benchmark")
 
         if prompt_mask is not None:
             if tuple(prompt_mask.shape) != (b, s0):
@@ -474,6 +667,19 @@ class GPT:
             last_h, caches = self._prefill(params, input_ids, total)
         first_logits = self.lm_logits(params, last_h[:, None])[:, 0]
 
+        if decode_impl == "stacked":
+            stacked = self.stack_decode_params(params,
+                                               weight_quant=weight_quant)
+            caches = self._stack_caches(caches)
+
+            def step(caches, tok, pos):
+                return self._decode_step_stacked(
+                    params, stacked, caches, tok, pos, pad,
+                    decode_attention=decode_attention)
+        else:
+            def step(caches, tok, pos):
+                return self._decode_step(params, caches, tok, pos, pad)
+
         def pick(logits, step_rng):
             if temperature <= 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -489,16 +695,20 @@ class GPT:
         tok0 = pick(first_logits, step_rng(0))
 
         if eos_id is None:
-            def body(carry, step):
+            def body(carry, i):
                 caches, tok, pos = carry
-                logits, caches = self._decode_step(params, caches, tok,
-                                                   pos, pad)
-                nxt = pick(logits, step_rng(step + 1))
+                logits, caches = step(caches, tok, pos)
+                nxt = pick(logits, step_rng(i + 1))
                 return (caches, nxt, pos + 1), tok
 
+            # tokens_per_dispatch=K unrolls K token steps into each
+            # loop body: ~1/K the loop-bookkeeping overhead per token,
+            # and XLA schedules across the K steps' kernels
             (_, last_tok, _), toks = lax.scan(
                 body, (caches, tok0, jnp.int32(s0)),
-                jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+                jnp.arange(max_new_tokens - 1, dtype=jnp.int32),
+                unroll=max(1, min(tokens_per_dispatch,
+                                  max_new_tokens - 1)))
             # toks carries tokens 0..max_new-2 (each body emits its
             # INPUT token); the final pick is appended explicitly
             return jnp.concatenate([toks.transpose(1, 0),
@@ -525,8 +735,7 @@ class GPT:
             # just finished), matching the scan path's
             # one-decode-per-emitted-token cost
             def dec(caches, tok, pos):
-                logits, caches = self._decode_step(params, caches, tok,
-                                                   pos, pad)
+                logits, caches = step(caches, tok, pos)
                 return pick(logits, step_rng(t + 1)), caches
 
             nxt, caches = lax.cond(

@@ -6,6 +6,7 @@ softmax, blocked HBM→VMEM movement). See /opt/skills/guides/pallas_guide.md
 for the kernel playbook this follows.
 """
 
+from .decode_attention import decode_attention
 from .flash_attention import flash_attention
 
-__all__ = ["flash_attention"]
+__all__ = ["decode_attention", "flash_attention"]

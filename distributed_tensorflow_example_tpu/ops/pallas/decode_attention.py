@@ -1,0 +1,158 @@
+"""Single-query decode attention over the KV-cache slab as one Pallas kernel.
+
+The decode step's attention is the per-op latency floor's biggest owner
+after layernorm (PROFILE_r05_decode: ~36 attention fusions at ~15 µs per
+token-step — mask build, score, softmax, context as separate small XLA
+fusions on [B, 1, H, D]-sized tensors). This kernel collapses that chain
+into ONE program per (batch row, head): it reads K/V straight from the
+[B, T, H, D] cache slab (no transpose, no repacking — the BlockSpec
+index map picks the head plane), builds the ragged ``pad``/``pos``
+validity mask from scalars in SMEM with an in-kernel iota, and runs the
+f32 softmax + context matmul in VMEM. One kernel per layer per token
+step instead of ~4-6.
+
+Numerics mirror ``ops.attention.multi_head_attention(impl="xla")``:
+scores in f32 scaled by 1/sqrt(D), NEG_INF masking (exp underflows to
+exactly 0 — slot ``pos`` is always valid, so no fully-masked rows
+exist), probabilities cast to the value dtype before the context matmul
+with f32 accumulation.
+
+Scope: the kernel path needs Mosaic-friendly tiles — the score row's
+lane dim is the cache length T (T % 128 == 0) and the head dim must be
+MXU-aligned (D == 64 or D % 128 == 0). Anything else falls back to the
+XLA path (which the ``"loop"`` decode impl uses anyway). On non-TPU
+backends the kernel runs in Pallas interpret mode so CPU tests exercise
+the same code path (same recipe as flash_attention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..attention import NEG_INF, multi_head_attention
+
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# repo meets (sandbox 0.4.x vs the chip runtime); take whichever exists
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def tile_friendly(total: int, head_dim: int) -> bool:
+    """Kernel-path tile constraints: the [1, T] score row puts T in the
+    lane dim (128-multiples) and the context matmul wants an MXU-aligned
+    head dim — the same D rule as flash_attention."""
+    return total % 128 == 0 and (head_dim == 64 or head_dim % 128 == 0)
+
+
+def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, *,
+            total: int, sm_scale: float):
+    b = pl.program_id(0)
+    q = q_ref[0].astype(jnp.float32)                    # [1, D]
+    k = k_ref[0].astype(jnp.float32)                    # [T, D]
+    v = v_ref[0]                                        # [T, D]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * sm_scale
+    # ragged pad/pos mask fused in: slot j of row b is live iff
+    # pad_b <= j <= pos (pos = the slot the current token sits at)
+    kpos = lax.broadcasted_iota(jnp.int32, (1, total), 1)
+    live = (kpos <= pos_ref[0]) & (kpos >= pad_ref[b])
+    s = jnp.where(live, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)                                  # masked -> exact 0
+    probs = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(v.dtype)
+    o_ref[0] = lax.dot_general(
+        probs, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _dispatch(q, k, v, pos, pad):
+    """Grid (B, H); per program ONE [T, D] K/V plane of the cache slab.
+
+    Mosaic tiling note: the per-head plane is carved out of the
+    [B, T, H·D] *view* of the slab (a free, contiguous reshape), so
+    every block's trailing 2-D tile is [T, D] (sublane T — a 128-
+    multiple — by lane D) or a [1, D] row whose singleton matches its
+    array dim. Blocking the 4-D [B, T, H, D] layout directly would put
+    a size-1 tile against the H dim (neither 8-divisible nor the array
+    dim) — the interpret-passes-but-Mosaic-fails shape documented in
+    the verify notes."""
+    b, t, h, d = k.shape
+    q3 = q.reshape(b * h, 1, d)
+    k3 = k.reshape(b, t, h * d)
+    v3 = v.reshape(b, t, h * d)
+    out = pl.pallas_call(
+        functools.partial(_kernel, total=t, sm_scale=1.0 / math.sqrt(d)),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),               # pos [1]
+            pl.BlockSpec(memory_space=pltpu.SMEM),               # pad [B]
+            pl.BlockSpec((1, 1, d), lambda bb, hh: (bb * h + hh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bb, hh: (bb, 0, hh)),
+            pl.BlockSpec((1, t, d), lambda bb, hh: (bb, 0, hh)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d),
+                               lambda bb, hh: (bb * h + hh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), v.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=_interpret(),
+    )(pos, pad, q3, k3, v3)
+    return out.reshape(b, h, d)
+
+
+def xla_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         pos, pad) -> jax.Array:
+    """Reference path: the exact ``multi_head_attention(impl="xla")``
+    call the ``"loop"`` decode step makes — the kernel's parity oracle
+    and the fallback for tile-unfriendly shapes."""
+    total = k.shape[1]
+    slots = jnp.arange(total, dtype=jnp.int32)
+    live = (slots[None, :] <= pos) & (slots[None, :] >= pad[:, None])
+    ctx = multi_head_attention(q[:, None], k, v,
+                               mask=live[:, None, None, :], impl="xla")
+    return ctx[:, 0]
+
+
+def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                     pos, pad, impl: str = "auto") -> jax.Array:
+    """One-query attention against the cache slab.
+
+    ``q``: [B, H, D] (the current token's heads); ``k``/``v``:
+    [B, T, H, D] cache slabs (slot ``pos`` already written); ``pos``:
+    scalar int32 cache slot of the current token; ``pad``: [B] int32
+    per-row dead-slot count (ragged prompts). Returns [B, H, D] context.
+
+    ``impl``: ``"auto"`` takes the kernel on TPU when
+    :func:`tile_friendly` holds and the XLA path otherwise; ``"pallas"``
+    forces the kernel (interpret mode off-TPU — the CPU test path);
+    ``"xla"`` forces the reference.
+    """
+    b, t, h, d = k.shape
+    if q.shape != (b, h, d):
+        raise ValueError(f"q shape {q.shape} != {(b, h, d)} from cache "
+                         f"{k.shape}")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown decode attention impl {impl!r}")
+    use_kernel = (impl == "pallas"
+                  or (impl == "auto" and jax.default_backend() == "tpu"
+                      and tile_friendly(t, d)))
+    if use_kernel and not tile_friendly(t, d):
+        raise ValueError(
+            f"decode_attention kernel needs T % 128 == 0 and an "
+            f"MXU-aligned head dim, got T={t} D={d} (use impl='auto' "
+            "for the XLA fallback)")
+    if not use_kernel:
+        return xla_decode_attention(q, k, v, pos=pos, pad=pad)
+    pos1 = jnp.asarray(pos, jnp.int32).reshape((1,))
+    return _dispatch(q, k, v, pos1, pad.astype(jnp.int32))

@@ -38,6 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..attention import NEG_INF
 
+# jax renamed TPUCompilerParams -> CompilerParams across the versions this
+# repo meets (sandbox 0.4.x vs the chip runtime); take whichever exists
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 DEFAULT_BLOCK = 128
 
 
@@ -142,7 +147,7 @@ def _fwd(q3, k3, v3, mask2, *, heads: int, blk_q: int, blk_k: int,
         scratch_shapes=[pltpu.VMEM((blk_q, 1), jnp.float32),
                         pltpu.VMEM((blk_q, 1), jnp.float32),
                         pltpu.VMEM((blk_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -260,7 +265,7 @@ def _bwd(q3, k3, v3, o3, do3, L, mask2, *, heads: int, blk_q: int,
         out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)
@@ -291,7 +296,7 @@ def _bwd(q3, k3, v3, o3, do3, L, mask2, *, heads: int, blk_q: int,
                    jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
         scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
                         pltpu.VMEM((blk_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*args)

@@ -62,6 +62,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import SyncConfig
 from ..train.state import TrainState
+from .collectives import shard_map
 from .mesh import AxisNames, batch_axis_size
 from .sharding import ShardingRules, batch_pspec, state_shardings
 
@@ -297,7 +298,7 @@ class SyncReplicas:
         replicated (fsdp/tp rules are the auto path's job)."""
         axes = AxisNames.BATCH
 
-        @partial(jax.shard_map, mesh=self.mesh,
+        @partial(shard_map, mesh=self.mesh,
                  in_specs=(P(), jax.tree_util.tree_map(
                      lambda _: batch_pspec(), batch)),
                  out_specs=P(),

@@ -151,6 +151,8 @@ def export_generator(model, params, out_dir: str, *,
                      top_k: int = 0, top_p: float = 0.0,
                      eos_id: int | None = None, pad_id: int = 0,
                      ragged: bool = False,
+                     decode_impl: str = "stacked",
+                     tokens_per_dispatch: int = 1,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Serialize ``model.generate`` (params baked; greedy or
     temperature/top-k/top-p sampling, optional EOS early-stop) as a
@@ -160,11 +162,28 @@ def export_generator(model, params, out_dir: str, *,
     plus ``"prompt_mask"`` when ``ragged``) to ``[B, max_new_tokens]``
     token ids. Static shapes throughout (the decode loop's cache layout
     depends on prompt and generation lengths, so the artifact is
-    inherently static-shape; the metadata records it as such)."""
+    inherently static-shape; the metadata records it as such).
+
+    The artifact rides the decode fast path (``decode_impl="stacked"``
+    + optional ``tokens_per_dispatch`` amortization — recorded in the
+    metadata). Decode attention in the artifact: multi-platform
+    exports, and ANY export traced off-TPU, pin the portable XLA path
+    (a Mosaic custom call cannot lower for the artifact's other
+    platforms — and the kernel's interpret-mode fallback on a non-TPU
+    tracing host must never be baked into a TPU artifact). Only a
+    TPU-only export traced ON a TPU host keeps the model's own
+    (kernel-capable) setting. When sampling, the serve-time PRNG
+    contract is recorded as ``prng_impl`` so the HTTP server
+    synthesizes ``rng`` key data with the impl the program was traced
+    under."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
 
     sampled = temperature > 0.0
+    tpu_only_on_tpu = (tuple(platforms) == ("tpu",)
+                       and jax.default_backend() == "tpu")
+    decode_attention = ("xla" if decode_impl == "stacked"
+                        and not tpu_only_on_tpu else None)
 
     def serve(feats):
         return model.generate(
@@ -172,6 +191,9 @@ def export_generator(model, params, out_dir: str, *,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_id=eos_id, pad_id=pad_id,
             prompt_mask=feats.get("prompt_mask"),
+            decode_impl=decode_impl,
+            decode_attention=decode_attention,
+            tokens_per_dispatch=tokens_per_dispatch,
             rng=(jax.random.wrap_key_data(feats["rng"])
                  if sampled else None))
 
@@ -188,12 +210,22 @@ def export_generator(model, params, out_dir: str, *,
     exported = jax_export.export(
         jax.jit(serve), platforms=list(platforms))(specs)
 
+    extra_meta = {}
+    if sampled:
+        # the serve-time rng contract: key data synthesized with any
+        # OTHER default impl has a different shape/meaning and would
+        # surface as an opaque executable error (ADVICE r5) — record
+        # the impl the trace consumed so serving_http can honor it
+        extra_meta["prng_impl"] = str(
+            jax.random.key_impl(jax.random.key(0)))
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
                            max_new_tokens=max_new_tokens,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, eos_id=eos_id, pad_id=pad_id,
-                           ragged=ragged)
+                           ragged=ragged, decode_impl=decode_impl,
+                           tokens_per_dispatch=tokens_per_dispatch,
+                           **extra_meta)
 
 
 class ServableModel:

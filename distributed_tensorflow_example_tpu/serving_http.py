@@ -220,8 +220,34 @@ class PredictServer:
                 raise ValueError(
                     f"'seed' must be an int64-range integer, got "
                     f"{seed!r}")
-            feats["rng"] = np.asarray(
-                jax.random.key_data(jax.random.key(seed)))
+            # build the key under the PRNG impl the artifact was traced
+            # with (recorded at export since round 6); an artifact
+            # exported under e.g. rbg takes [4]-shaped uint32 key data,
+            # not threefry's [2] — the serve-time default impl is NOT
+            # part of the artifact's contract. Validate the synthesized
+            # data against the recorded rng signature so any residual
+            # mismatch (older artifact + non-default server impl) is a
+            # clear 4xx, not an opaque executable 500 (ADVICE r5).
+            impl = self.servable.meta.get("prng_impl")
+            try:
+                key = (jax.random.key(seed, impl=impl) if impl
+                       else jax.random.key(seed))
+            except (ValueError, TypeError) as e:
+                raise _ServerFault(
+                    f"artifact metadata names unknown prng_impl "
+                    f"{impl!r}: {e}") from e
+            data = np.asarray(jax.random.key_data(key))
+            spec = self.servable.input_signature["rng"]
+            want = tuple(spec["shape"])
+            if data.shape != want or str(data.dtype) != spec["dtype"]:
+                raise ValueError(
+                    f"cannot synthesize 'rng' for this artifact: the "
+                    f"server PRNG impl {impl or 'default'!r} yields key "
+                    f"data {data.shape} {data.dtype}, the artifact was "
+                    f"exported expecting {want} {spec['dtype']} — "
+                    "re-export with a matching jax_default_prng_impl "
+                    "(new exports record prng_impl in export.json)")
+            feats["rng"] = data
         toks = self._execute(feats)
         return {"generations": toks[:n].tolist()}
 

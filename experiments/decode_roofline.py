@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""Autoregressive decode roofline + lever sweep (VERDICT r4 task #3).
+"""Autoregressive decode roofline + lever table (VERDICT r4 task #3,
+r5 task #3 — the per-token kernel-floor attack).
 
-Round 4 measured KV-cache greedy decode at 1.55 ms/token-step (b8,
-prompt 128 + 128 new, GPT-small) — ~5x above the naive weight-traffic
-bound (~0.25 GB of bf16 params re-read per token-step / 819 GB/s ~=
-0.31 ms). This script measures the decode step against that bound and
-runs the candidate levers:
+Round 5 measured KV-cache greedy decode at 0.67 ms/token-step of device
+time (b8, prompt 128 + 128 new, GPT-small) — 2.5x the weight-traffic
+bound (0.267 ms), root-caused to a per-op latency floor (~100 small
+kernels/token — PROFILE_r05_decode). This script measures the decode
+step against that bound and runs the levers:
 
   batch   — b in {1, 8, 16, 32, 64}: weight reads amortize over rows,
             so tokens/s/chip should scale until something else binds
@@ -13,14 +14,30 @@ runs the candidate levers:
             new grows attention/DUS traffic; measures its slope
   trace   — jax.profiler capture of one generation dispatch, reduced
             with utils.trace_summary (committed as PROFILE_r05_decode)
+  lever   — the round-6 fast-path lever table, one row per config:
+              loop     the pre-fast-path reference (per-layer Python
+                       loop, 3 QKV matmuls, XLA 1-query attention)
+              stacked  lax.scan over restacked layer params + fused
+                       QKV, XLA attention (isolates the scan/fusion
+                       win from the kernel win)
+              pallas   stacked + the single-query Pallas cache-slab
+                       attention kernel (decode_attention="auto":
+                       engages on TPU; off-TPU the row equals stacked)
+              ktoken   pallas + tokens_per_dispatch=4 (K token steps
+                       unrolled per loop body)
+              int8     ktoken + int8-quantized stacked layer weights
+                       (LOSSY — the weight-traffic comparison row)
 
 Each cell is a fresh process (axon-tunnel timing lesson, round 4);
-prints one JSON line per cell. Numbers + verdicts live in BASELINE.md.
+prints one JSON line per cell. Numbers + verdicts live in BASELINE.md
+("Decode fast path").
 
 Usage: python experiments/decode_roofline.py batch 8
        python experiments/decode_roofline.py newlen 256
+       python experiments/decode_roofline.py lever stacked
        python experiments/decode_roofline.py trace /tmp/decode_trace
        python experiments/decode_roofline.py --all
+       python experiments/decode_roofline.py --levers
 """
 
 import functools
@@ -32,6 +49,17 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PROMPT = 128
+
+#: the lever table rows: cumulative fast-path configs (generate kwargs)
+LEVERS = {
+    "loop": {"decode_impl": "loop"},
+    "stacked": {"decode_impl": "stacked", "decode_attention": "xla"},
+    "pallas": {"decode_impl": "stacked", "decode_attention": "auto"},
+    "ktoken": {"decode_impl": "stacked", "decode_attention": "auto",
+               "tokens_per_dispatch": 4},
+    "int8": {"decode_impl": "stacked", "decode_attention": "auto",
+             "tokens_per_dispatch": 4, "weight_quant": "int8"},
+}
 
 
 def _build(batch: int):
@@ -55,26 +83,37 @@ def _build(batch: int):
     return model, params, ids
 
 
-def measure(batch: int, max_new: int, *, reps=8, warmup=2) -> dict:
+def measure(batch: int, max_new: int, *, reps=7, warmup=2,
+            lever: str | None = None, tiny: bool = False) -> dict:
     # ONE decode-measurement implementation: bench.py's _run_decode
-    # (device_get timing + weight-floor retry + suspect flag) — the
-    # experiment and the gate must never measure two different ways
-    # (that divergence is how the round-4 1.55 ms and the artifacted
-    # 0.001 ms readings coexisted)
+    # (device_get timing + median-of-repeats + weight-floor retry +
+    # suspect flag) — the experiment and the gate must never measure
+    # two different ways (that divergence is how the round-4 1.55 ms
+    # and the artifacted 0.001 ms readings coexisted)
     from bench import _run_decode
 
-    tps, token_step_ms, bound_ms, suspect = _run_decode(
-        batch=batch, prompt=PROMPT, max_new=max_new, reps=reps,
-        warmup=warmup, tiny=False)
-    return {
-        "batch": batch, "prompt": PROMPT, "max_new": max_new,
+    gen_kwargs = LEVERS[lever] if lever else None
+    tps, token_step_ms, bound_ms, spread, suspect = _run_decode(
+        batch=batch, prompt=PROMPT if not tiny else 16,
+        max_new=max_new, reps=reps, warmup=warmup, tiny=tiny,
+        gen_kwargs=gen_kwargs)
+    out = {
+        "batch": batch, "prompt": PROMPT if not tiny else 16,
+        "max_new": max_new,
         "gen_ms": round(token_step_ms * max_new, 1),
         "token_step_ms": round(token_step_ms, 3),
         "tokens_per_s_chip": round(tps),
         # naive bound: every param (bf16) read once per token-step
         "weight_bound_ms": round(bound_ms, 3),
+        "spread": round(spread, 4),
         "suspect": suspect,
     }
+    if lever:
+        import jax
+        out["lever"] = lever
+        out["platform"] = jax.devices()[0].platform
+        out["tiny"] = tiny
+    return out
 
 
 def trace(outdir: str) -> dict:
@@ -89,18 +128,24 @@ def trace(outdir: str) -> dict:
     return {"trace": outdir}
 
 
+def _subprocess_cells(cells) -> None:
+    env = dict(os.environ,
+               DTX_JAX_CACHE=os.environ.get("DTX_JAX_CACHE",
+                                            "/tmp/dtx_jax_cache"))
+    me = os.path.abspath(__file__)
+    for mode, arg in cells:
+        subprocess.run([sys.executable, me, mode, str(arg)],
+                       env=env, check=False)
+
+
 def main() -> None:
     if sys.argv[1:2] == ["--all"]:
-        env = dict(os.environ,
-                   DTX_JAX_CACHE=os.environ.get("DTX_JAX_CACHE",
-                                                "/tmp/dtx_jax_cache"))
-        me = os.path.abspath(__file__)
-        for b in (1, 8, 16, 32, 64):
-            subprocess.run([sys.executable, me, "batch", str(b)],
-                           env=env, check=False)
-        for n in (32, 256):
-            subprocess.run([sys.executable, me, "newlen", str(n)],
-                           env=env, check=False)
+        _subprocess_cells([("batch", b) for b in (1, 8, 16, 32, 64)]
+                          + [("newlen", n) for n in (32, 256)])
+        return
+    if sys.argv[1:2] == ["--levers"]:
+        # the round-6 lever table: one fresh process per row
+        _subprocess_cells([("lever", name) for name in LEVERS])
         return
     mode, arg = sys.argv[1], sys.argv[2]
     import jax
@@ -111,6 +156,16 @@ def main() -> None:
             out = measure(int(arg), 128)
         elif mode == "newlen":
             out = measure(8, int(arg))
+        elif mode == "lever":
+            if arg not in LEVERS:
+                raise SystemExit(f"unknown lever {arg!r}; have "
+                                 f"{sorted(LEVERS)}")
+            # off-TPU the GPT-small decode is minutes per row: fall back
+            # to the tiny model (relative ordering only, labeled)
+            on_tpu = jax.devices()[0].platform == "tpu"
+            out = measure(8, 128 if on_tpu else 32, lever=arg,
+                          reps=7 if on_tpu else 3,
+                          warmup=2 if on_tpu else 1, tiny=not on_tpu)
         elif mode == "trace":
             out = trace(arg)
         else:

@@ -1,7 +1,11 @@
-"""robust_time (bench.py): the artifact-resistant measurement core the
-driver's BENCH gate rests on. The tunnel artifact is always absurdly
-fast, so the helper must take the slower pass, retry on physically
-impossible or wildly disagreeing readings, and flag what it cannot fix.
+"""robust_time + median_repeats (bench.py): the artifact-resistant
+measurement cores the driver's BENCH gate rests on. The tunnel artifact
+is always absurdly fast, so robust_time must take the slower pass,
+retry on physically impossible or wildly disagreeing readings, and flag
+what it cannot fix; the decode row's median_repeats must publish the
+median of >=5 repeats (immune to single-call outliers in either
+direction), its spread, and a suspect flag when the median itself sits
+below the physical floor.
 """
 
 import os
@@ -12,7 +16,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from bench import robust_time
+from bench import median_repeats, robust_time
 
 
 def _passes(seq):
@@ -57,6 +61,50 @@ def test_no_flops_estimate_uses_disagreement_only():
     # documented limitation — the helper still returns the measurement
     dt, suspect = robust_time(_passes([0.001, 0.001]), steps=10)
     assert dt == pytest.approx(0.001) and not suspect
+
+
+def test_median_repeats_takes_the_median_and_reports_spread():
+    """5 repeats with one slow and one fast outlier: the median is the
+    honest middle reading and the spread names the worst deviation."""
+    med, spread, suspect = median_repeats(
+        _passes([1.0, 0.9, 1.1, 1.02, 0.98]), reps=5)
+    assert med == 1.0 and not suspect
+    assert spread == pytest.approx(0.1)
+
+
+def test_median_repeats_shrugs_off_single_fast_artifact():
+    """The tunnel's return-without-running artifact corrupts ONE call:
+    a max-of-two estimate wobbles, the median of 5 does not."""
+    med, spread, suspect = median_repeats(
+        _passes([0.001, 1.0, 1.01, 0.99, 1.0]), reps=5)
+    assert med == 1.0 and not suspect
+    assert spread == pytest.approx(0.999)   # the outlier IS the spread
+
+
+def test_median_repeats_floor_retries_then_settles():
+    # whole first sample corrupted below the physical floor; the
+    # second sample is honest
+    med, spread, suspect = median_repeats(
+        _passes([0.001] * 3 + [1.0, 1.05, 0.95]), reps=3, floor_s=0.5)
+    assert med == 1.0 and not suspect
+
+
+def test_median_repeats_persistently_impossible_is_suspect():
+    med, spread, suspect = median_repeats(
+        _passes([0.001] * 9), reps=3, floor_s=0.5, retries=3)
+    assert suspect and med == 0.001
+
+
+def test_median_repeats_validates_reps():
+    with pytest.raises(ValueError, match="reps"):
+        median_repeats(_passes([1.0]), reps=0)
+
+
+def test_median_repeats_single_rep_off_tpu_mode():
+    # the CPU-sanity config times one repeat with no floor: the value
+    # passes through, spread 0, never suspect
+    med, spread, suspect = median_repeats(_passes([0.7]), reps=1)
+    assert med == 0.7 and spread == 0.0 and not suspect
 
 
 def test_vs_baseline_excludes_suspect_measurements():

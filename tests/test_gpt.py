@@ -280,6 +280,45 @@ def test_top_k_sampling_stays_inside_the_top_set():
         cur = np.concatenate([cur, got[:, t:t + 1]], axis=1)
 
 
+def test_filter_logits_top_k_keeps_boundary_ties():
+    """The documented >=-threshold tie contract: every token exactly
+    tied with the kth-largest logit survives top-k filtering, so ties
+    can keep MORE than k tokens."""
+    from distributed_tensorflow_example_tpu.ops.attention import NEG_INF
+    m = _model()
+    logits = jnp.asarray([[5.0, 5.0, 3.0, 1.0, 5.0],
+                          [9.0, 2.0, 2.0, 1.0, 0.0]])
+    neg = np.float32(NEG_INF)          # the f32-rounded fill the op uses
+    out = np.asarray(m._filter_logits(logits, top_k=1, top_p=0.0))
+    # row 0: THREE tokens tie the top value — all survive
+    np.testing.assert_array_equal(
+        out[0], np.asarray([5.0, 5.0, neg, neg, 5.0], np.float32))
+    # row 1: unique max — strict top-1
+    np.testing.assert_array_equal(
+        out[1], np.asarray([9.0, neg, neg, neg, neg], np.float32))
+    # k=2 in row 1: both 2.0s tie the kth-largest and both survive
+    out2 = np.asarray(m._filter_logits(logits, top_k=2, top_p=0.0))
+    np.testing.assert_array_equal(
+        out2[1], np.asarray([9.0, 2.0, 2.0, neg, neg], np.float32))
+
+
+def test_filter_logits_top_p_keeps_threshold_ties():
+    """Nucleus filtering keeps every token tied with the threshold
+    logit: probs (0.4, 0.3, 0.3) at top_p=0.5 keep the 0.4 and BOTH
+    0.3-tied tokens (the nucleus is {0.4, first 0.3}; the second 0.3
+    ties the threshold and survives by the >= contract)."""
+    from distributed_tensorflow_example_tpu.ops.attention import NEG_INF
+    m = _model()
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.3]]))
+    out = np.asarray(m._filter_logits(logits, top_k=0, top_p=0.5))
+    assert (out > NEG_INF / 2).all(), out
+    # untied control: (0.4, 0.35, 0.25) at the same top_p drops the tail
+    logits2 = jnp.log(jnp.asarray([[0.4, 0.35, 0.25]]))
+    out2 = np.asarray(m._filter_logits(logits2, top_k=0, top_p=0.5))
+    assert (out2[0, :2] > NEG_INF / 2).all()
+    assert out2[0, 2] == NEG_INF
+
+
 def test_generate_knob_validation():
     m = _model()
     params = m.init(jax.random.key(0))

@@ -285,6 +285,44 @@ def test_generate_route_round_trip(tmp_path):
         assert e.value.code == 400
 
 
+def test_generate_rng_honors_recorded_prng_impl(tmp_path):
+    """The export records prng_impl; the server synthesizes the rng key
+    under THAT impl, and a residual shape mismatch (legacy artifact +
+    different server default) is a clear 400 naming both shapes — not
+    the opaque executable 500 of ADVICE r5."""
+    from distributed_tensorflow_example_tpu.serving import export_generator
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    params = m.init(jax.random.key(0))
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 1000, (1, 6), dtype=np.int32)
+    d = str(tmp_path / "sampled")
+    export_generator(m, params, d, prompt_len=6, max_new_tokens=3,
+                     batch_size=1, temperature=1.0, platforms=("cpu",))
+    with PredictServer(d) as srv:
+        assert srv.servable.meta["prng_impl"] == str(
+            jax.random.key_impl(jax.random.key(0)))
+        ok = _post_verb(srv.port, srv.name, "generate",
+                        {"inputs": {"input_ids": ids.tolist()}, "seed": 1})
+        assert np.asarray(ok["generations"]).shape == (1, 3)
+        # simulate the mismatch: an artifact whose recorded impl yields
+        # key data of a DIFFERENT shape than the exported signature
+        # (e.g. legacy threefry artifact served by an rbg-default
+        # process) — must be a 400 that names both shapes
+        srv.servable.meta["prng_impl"] = "rbg"       # [4]-word key data
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist()}, "seed": 1})
+        assert e.value.code == 400
+        msg = json.loads(e.value.read())["error"]
+        assert "rng" in msg and "prng" in msg.lower()
+        # bogus impl name in metadata is the server's fault: 500
+        srv.servable.meta["prng_impl"] = "no-such-impl"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_verb(srv.port, srv.name, "generate",
+                       {"inputs": {"input_ids": ids.tolist()}, "seed": 1})
+        assert e.value.code == 500
+
+
 def test_predict_artifact_rejects_generate_route(servable_dir):
     d, feats, _ = servable_dir
     with PredictServer(d) as srv:
