@@ -26,11 +26,17 @@ devices are present (the driver runs it on one real TPU chip):
 Eight are training throughput, one is decode; a regression in ANY of
 the nine moves ``vs_baseline``.
 
-For each, an MFU estimate = XLA-reported FLOPs for the compiled step /
-measured step time / chip peak (bf16) is recorded. The reference publishes
-no numbers (BASELINE.md), so ``bench_baseline.json`` holds this repo's own
-first measurements; ``vs_baseline`` is measured/baseline of the headline
-metric (>1 is faster).
+For each, an MFU estimate = step FLOPs / measured step time / chip peak
+(bf16) is recorded, with its basis published per row as
+``{key}_mfu_basis``: ``"cost_analysis"`` = XLA-reported FLOPs for the
+compiled step; ``"analytic"`` = cost-analysis FLOPs PLUS the closed-form
+flash-attention FLOPs XLA cannot see inside the Pallas custom call
+(flash_attention.attention_train_flops) — so the bert_long/gpt_long MFU
+rows are comparable to the seq-128 rows (VERDICT r5 weak #1). The same
+augmented number feeds robust_time's physical-impossibility check. The
+reference publishes no numbers (BASELINE.md), so ``bench_baseline.json``
+holds this repo's own first measurements; ``vs_baseline`` is
+measured/baseline of the headline metric (>1 is faster).
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
@@ -79,7 +85,11 @@ def _chip_peak() -> float | None:
 
 
 def _step_flops(compiled) -> float | None:
-    """XLA cost-analysis FLOPs for one compiled step (None if unavailable)."""
+    """XLA cost-analysis FLOPs for one compiled step (None if unavailable).
+
+    Pallas custom calls are opaque to the cost analysis (their FLOPs count
+    as zero) — flash workloads add ``_flash_step_flops`` on top.
+    """
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -88,6 +98,40 @@ def _step_flops(compiled) -> float | None:
         return float(f) if f and f > 0 else None
     except Exception:
         return None
+
+
+def _flash_step_flops(cfg, model, model_name: str, batch: int,
+                      host_batch: dict | None) -> float | None:
+    """Closed-form attention FLOPs for one train step when (and only
+    when) the Pallas flash kernel actually engages — the piece XLA's
+    cost analysis cannot see. None for xla-attention configs and for
+    shapes where flash falls back to XLA (the fallback's einsums ARE
+    counted by the cost analysis; adding the analytic number there would
+    double-count)."""
+    if cfg.attention_impl != "flash" or not host_batch:
+        return None
+    ids = host_batch.get("input_ids")
+    if ids is None:
+        return None
+    from distributed_tensorflow_example_tpu.config import (
+        flash_attention_kwargs)
+    from distributed_tensorflow_example_tpu.ops.pallas.flash_attention \
+        import attention_train_flops, effective_bwd_variant, kernel_engages
+    fkw = flash_attention_kwargs(cfg)
+    mc = model.cfg
+    seq = int(ids.shape[1])
+    head_dim = mc.hidden // mc.heads
+    blocks = {k: fkw[k] for k in ("block_q", "block_k", "bwd_block")
+              if k in fkw}
+    if not kernel_engages(seq, head_dim, **blocks):
+        return None
+    return attention_train_flops(
+        batch, seq, mc.hidden, mc.layers,
+        causal=model_name.startswith("gpt"),
+        # count what EXECUTES: fused silently degrades to split past
+        # the VMEM slab limit
+        bwd_variant=effective_bwd_variant(
+            seq, head_dim, fkw.get("bwd_variant", "split")))
 
 
 def robust_time(timed_pass, *, steps: int, flops=None, peak=None,
@@ -153,6 +197,30 @@ def median_repeats(timed_single, *, reps: int, floor_s: float | None = None,
     return med, spread, suspect
 
 
+def decode_device_component(short_s: float, long_s: float,
+                            new_short: int, new_long: int,
+                            ) -> tuple[float, float]:
+    """Two-point fit splitting a generation's wall-clock into per-token
+    device time and per-call overhead (both ms).
+
+    Each decode CALL pays ~100 ms of host/tunnel overhead around the
+    device steady state (measured: gen_ms ≈ 99 + 0.84·new, BASELINE.md
+    decode roofline) — ~50% of the b8 prompt128+new128 gate row's
+    wall-clock, so tunnel jitter could move that row ±5% with zero repo
+    change (VERDICT r5 weak #4). Timing the SAME program at two
+    generation lengths cancels the per-call constant: the slope
+    ``(long - short) / (new_long - new_short)`` is the per-token-step
+    device component (tunnel jitter hits both medians once each, not
+    per token), and the intercept is the published overhead estimate.
+    """
+    if new_long <= new_short:
+        raise ValueError(f"need new_long > new_short, got "
+                         f"{new_long} <= {new_short}")
+    slope_ms = (long_s - short_s) / (new_long - new_short) * 1e3
+    overhead_ms = short_s * 1e3 - slope_ms * new_short
+    return slope_ms, overhead_ms
+
+
 def _run(model_name: str, *, batch: int, steps: int, warmup: int,
          opt: OptimizerConfig, make_batch, extra_cfg: dict | None = None,
          cfg_over: dict | None = None,
@@ -185,8 +253,8 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
         step_fn, n_calls = sync.multi_step, max(1, steps // k)
         steps = n_calls * k
     else:
-        placed2 = [sync.shard_batch(make_batch(model, batch, i))
-                   for i in range(2)]
+        host = [make_batch(model, batch, i) for i in range(2)]
+        placed2 = [sync.shard_batch(b) for b in host]
         placed = placed2[0]
         step_fn, n_calls = sync.step, steps
 
@@ -197,6 +265,12 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     flops = _step_flops(compiled)
     if flops and k > 1:
         flops /= k               # cost_analysis covers the whole K-step scan
+    # flash configs: add the in-kernel attention FLOPs the cost analysis
+    # cannot see, and say so in the published basis
+    attn_flops = _flash_step_flops(cfg, model, model_name, batch, host[0])
+    if flops and attn_flops:
+        flops += attn_flops
+    mfu_basis = "analytic" if (flops and attn_flops) else "cost_analysis"
 
     for i in range(max(1, warmup // k)):
         state, m = compiled(state, placed if k > 1 else placed2[i % 2])
@@ -217,7 +291,7 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     step_s = dt / steps
     eps_chip = batch / step_s / n_dev
     mfu = (flops / step_s / (peak * n_dev)) if (flops and peak) else None
-    return eps_chip, step_s * 1e3, mfu, suspect
+    return eps_chip, step_s * 1e3, mfu, mfu_basis, suspect
 
 
 def _mnist_batch(model, batch, i):
@@ -245,7 +319,8 @@ def _gpt_batch_at(seq: int):
 
 
 def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
-                warmup: int, tiny: bool, gen_kwargs: dict | None = None):
+                warmup: int, tiny: bool, gen_kwargs: dict | None = None,
+                amortize_new: int | None = None):
     """tokens/s/chip for the compiled KV-cache generation (the stacked
     fast path by default; ``gen_kwargs`` overrides decode_impl /
     decode_attention / tokens_per_dispatch / weight_quant for the
@@ -254,8 +329,13 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     drained via device_get (see the timing note below). The published
     number is the MEDIAN of ``reps`` per-generation timings after
     warmup (median_repeats — the de-noised gate methodology; spread is
-    the row's published ±noise). Returns (tokens_per_s_chip,
-    token_step_ms, weight_bound_ms, spread, suspect)."""
+    the row's published ±noise).
+
+    ``amortize_new``: additionally time the same program at this longer
+    generation length and publish the two-point DEVICE component
+    (``decode_device_component``) — the tunnel-jitter-immune number the
+    gate row regresses on once baselined. Returns a dict of row fields.
+    """
     import functools
 
     from distributed_tensorflow_example_tpu.config import (DataConfig,
@@ -305,8 +385,39 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     # per-chip = the whole number: the generation is a single-device
     # jit (no mesh), so dividing by the host's visible device count
     # would under-report on any multi-device host
-    return (batch * max_new / per_gen,
-            per_gen / max_new * 1e3, bound_ms, spread, suspect)
+    row = {
+        "tokens_s_chip": batch * max_new / per_gen,
+        "token_step_ms": per_gen / max_new * 1e3,
+        "weight_bound_ms": bound_ms,
+        "spread": spread,
+        "suspect": suspect,
+    }
+    if amortize_new is not None:
+        gen_long = jax.jit(functools.partial(
+            model.generate, max_new_tokens=amortize_new,
+            **(gen_kwargs or {})))
+        np.asarray(gen_long(params, ids))          # compile
+        for _ in range(warmup):
+            np.asarray(gen_long(params, ids))
+
+        def timed_long():
+            t0 = time.perf_counter()
+            np.asarray(gen_long(params, ids))
+            return time.perf_counter() - t0
+
+        per_long, spread_long, suspect_long = median_repeats(
+            timed_long, reps=reps,
+            floor_s=(bound_ms * 0.5 * amortize_new / 1e3)
+            if on_tpu else None)
+        dev_ms, overhead_ms = decode_device_component(
+            per_gen, per_long, max_new, amortize_new)
+        # a non-positive slope (longer generation measured FASTER) is
+        # physically impossible — a corrupt leg slipped past the floor
+        # check; flag it so the gate excludes the row
+        row.update(device_token_ms=dev_ms, call_overhead_ms=overhead_ms,
+                   long_spread=spread_long,
+                   suspect=suspect or suspect_long or dev_ms <= 0)
+    return row
 
 
 def _long_batch(model, batch, i):
@@ -343,9 +454,14 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
       dominate threefry's cost: 112.4 -> 89.1 ms/step measured).
     - moe_bert/bert_large @ b64: the measured sweet spots (BASELINE.md).
     - bert_long: the composed long-context capability (flash +
-      remat=full @ S=4096 b4 — the regime the plain XLA path cannot
-      reach); its MFU is vs the flash-kernel cost analysis and NOT
-      comparable to the seq-128 rows.
+      remat=none @ S=4096 b4 — the regime the plain XLA path cannot
+      reach); its MFU adds the closed-form flash-kernel FLOPs
+      (mfu_basis="analytic") and is comparable to the seq-128 rows.
+    - gpt_decode: the gate ratio moves to the two-point DEVICE
+      component (device_token_ms) as soon as a baseline for it exists —
+      wall-clock tokens/s keeps ~100 ms/call of tunnel overhead in the
+      denominator (~50% of the measurement) and its jitter was the gate
+      row's dominant noise (VERDICT r5 weak #4).
     """
     adamw = OptimizerConfig(name="adamw", learning_rate=1e-4)
     rbg = "rbg" if on_tpu else None
@@ -412,7 +528,11 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
              decode=dict(batch=8, prompt=128 if on_tpu else 16,
                          max_new=128 if on_tpu else 8,
                          reps=7 if on_tpu else 1,
-                         warmup=2 if on_tpu else 0, tiny=not on_tpu)),
+                         warmup=2 if on_tpu else 0, tiny=not on_tpu,
+                         # 4x-longer second leg: the two-point fit that
+                         # isolates the device component from the
+                         # ~100 ms/call tunnel overhead
+                         amortize_new=512 if on_tpu else 32)),
     ]
 
 
@@ -424,6 +544,13 @@ def vs_baseline_geomean(extra: dict, base: dict) -> float:
     de-corrupt — always absurdly FAST) is EXCLUDED: a corrupt reading
     must never inflate the gate. mnist prefers its dedicated baseline
     key and falls back to the legacy round-1 name — never both.
+
+    gpt_decode regresses on the tunnel-jitter-immune DEVICE component
+    (``gpt_decode_device_token_ms``, lower = faster, so the ratio
+    inverts) as soon as BOTH the baseline and the measurement carry it;
+    until the device-component baseline exists it stays on wall-clock
+    tokens/s — re-base with a methodology note at the first on-chip
+    run that records the new key.
     """
     mnist_base = (base.get("mnist_mlp_eps_chip")
                   or base.get("examples_per_sec_per_chip"))
@@ -441,6 +568,14 @@ def vs_baseline_geomean(extra: dict, base: dict) -> float:
         if extra.get(key.replace("_eps_chip", "_suspect")
                      .replace("_tokens_s_chip", "_suspect")):
             continue
+        if key == "gpt_decode_tokens_s_chip":
+            dev_b = base.get("gpt_decode_device_token_ms")
+            dev_m = extra.get("gpt_decode_device_token_ms")
+            # both must be POSITIVE: a negative slope (corrupt leg that
+            # dodged the suspect flag) in a ratio would NaN the geomean
+            if dev_b and dev_m and dev_b > 0 and dev_m > 0:
+                ratios.append(dev_b / dev_m)   # ms: lower is faster
+                continue
         if extra.get(key) and b:
             ratios.append(extra[key] / b)
     return float(np.prod(ratios) ** (1 / len(ratios))) if ratios else 1.0
@@ -468,15 +603,22 @@ def main() -> None:
             continue
         key = w["key"]
         if "decode" in w:
-            tps, ms, bound_ms, spread, suspect = _run_decode(**w["decode"])
-            extra[f"{key}_tokens_s_chip"] = round(tps)
-            extra[f"{key}_token_step_ms"] = round(ms, 3)
-            extra[f"{key}_weight_bound_ms"] = round(bound_ms, 3)
-            extra[f"{key}_spread"] = round(spread, 4)
-            if suspect:
+            row = _run_decode(**w["decode"])
+            extra[f"{key}_tokens_s_chip"] = round(row["tokens_s_chip"])
+            extra[f"{key}_token_step_ms"] = round(row["token_step_ms"], 3)
+            extra[f"{key}_weight_bound_ms"] = round(
+                row["weight_bound_ms"], 3)
+            extra[f"{key}_spread"] = round(row["spread"], 4)
+            if "device_token_ms" in row:
+                extra[f"{key}_device_token_ms"] = round(
+                    row["device_token_ms"], 4)
+                extra[f"{key}_call_overhead_ms"] = round(
+                    row["call_overhead_ms"], 2)
+                extra[f"{key}_long_spread"] = round(row["long_spread"], 4)
+            if row["suspect"]:
                 extra[f"{key}_suspect"] = True
             continue
-        eps, ms, mfu, suspect = _run(
+        eps, ms, mfu, mfu_basis, suspect = _run(
             w["model"], batch=w["batch"], steps=w["steps"],
             warmup=w["warmup"], opt=w["opt"],
             make_batch=w["make_batch"],
@@ -487,6 +629,7 @@ def main() -> None:
         extra[f"{key}_step_ms"] = round(ms, w.get("ms_digits", 2))
         if mfu:
             extra[f"{key}_mfu"] = round(mfu, 4)
+            extra[f"{key}_mfu_basis"] = mfu_basis
         if suspect:
             extra[f"{key}_suspect"] = True
 

@@ -21,7 +21,8 @@ import sys
 from ..cluster import ClusterSpec, WORKER_JOB
 from ..config import (CheckpointConfig, DataConfig, MeshShape,
                       ObservabilityConfig, OptimizerConfig, SyncConfig,
-                      TrainConfig, add_legacy_flags, parse_hosts)
+                      TrainConfig, add_legacy_flags,
+                      flash_attention_kwargs, parse_hosts)
 from ..utils.logging import get_logger
 
 log = get_logger("cli")
@@ -235,6 +236,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", default="xla", choices=["xla", "flash"],
                    help="attention implementation for transformer models "
                         "(flash = Pallas kernel, wins at long sequences)")
+    p.add_argument("--attention_block_q", type=int, default=0,
+                   help="flash kernel fwd Q-tile rows (multiple of 8; "
+                        "0 = kernel default 128); requires --attention "
+                        "flash — experiments/flash_sweep.py sweeps this")
+    p.add_argument("--attention_block_k", type=int, default=0,
+                   help="flash kernel fwd K-tile columns (multiple of "
+                        "128; 0 = kernel default 128); requires "
+                        "--attention flash")
+    p.add_argument("--attention_bwd_block", type=int, default=0,
+                   help="flash kernel bwd tile for both streamed dims "
+                        "(multiple of 128; 0 = inherit the fwd tiles); "
+                        "requires --attention flash")
+    p.add_argument("--attention_bwd", default="split",
+                   choices=["split", "fused"],
+                   help="flash backward variant: split = two-kernel "
+                        "FA-2 decomposition; fused = one kernel "
+                        "computing dq+dk+dv (scores recomputed once, "
+                        "~29%% fewer bwd matmul FLOPs); requires "
+                        "--attention flash")
     p.add_argument("--prng_impl", default="threefry2x32",
                    choices=["threefry2x32", "rbg", "unsafe_rbg"],
                    help="PRNG key implementation for the training rng "
@@ -367,6 +387,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         param_dtype=args.param_dtype,
         bn_stats_dtype=args.bn_stats_dtype,
         attention_impl=args.attention,
+        attention_block_q=args.attention_block_q,
+        attention_block_k=args.attention_block_k,
+        attention_bwd_block=args.attention_bwd_block,
+        attention_bwd=args.attention_bwd,
         remat=args.remat,
         prng_impl=args.prng_impl,
         mesh=parse_mesh(args.mesh) or MeshShape(data=-1),
@@ -619,6 +643,14 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(
             f"--lm_loss_chunk is a causal-LM knob (gpt/gpt_tiny), not "
             f"for model {args.model!r}")
+    cfg = config_from_args(args)          # reused below for the run
+    try:
+        # fail fast on flash-lever misuse: levers without --attention
+        # flash, or block values the kernel could never tile (it would
+        # silently fall back to XLA, hiding the typo for a whole run)
+        flash_attention_kwargs(cfg)
+    except ValueError as e:
+        raise SystemExit(str(e))
     if args.export_generator and not args.model.startswith("gpt"):
         raise SystemExit(
             f"--export_generator is a causal-LM knob (gpt/gpt_tiny), "
@@ -680,7 +712,6 @@ def main(argv: list[str] | None = None) -> int:
         server.join()
         return 0
 
-    cfg = config_from_args(args)
     if cfg.obs.debug_nans:
         import jax
         jax.config.update("jax_debug_nans", True)

@@ -254,6 +254,17 @@ class TrainConfig:
     bn_stats_dtype: str = "float32"  # BN batch-statistic reduction dtype
                                      # (conv models; running stats stay f32)
     attention_impl: str = "xla"      # xla | flash (pallas kernel; long-seq)
+    # flash-kernel tuning levers (attention_impl="flash" only; 0 = the
+    # kernel default). Sweepable from flags — experiments/flash_sweep.py
+    # — so block-size findings are reproducible, not folklore:
+    attention_block_q: int = 0       # fwd Q-tile rows (multiple of 8)
+    attention_block_k: int = 0       # fwd K-tile cols (multiple of 128)
+    attention_bwd_block: int = 0     # bwd tile for BOTH streamed dims
+                                     # (multiple of 128; 0 = inherit fwd)
+    attention_bwd: str = "split"     # split (two-kernel FA-2 bwd) |
+                                     # fused (one kernel: s/p/ds computed
+                                     # once for dq+dk+dv — ~29% fewer bwd
+                                     # matmul FLOPs, no K/V re-stream)
     remat: str = "none"              # none | full | dots — jax.checkpoint
                                      # each transformer layer (HBM for
                                      # recompute; long-context enabler)
@@ -267,6 +278,43 @@ class TrainConfig:
 
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
+
+
+def flash_attention_kwargs(cfg: TrainConfig) -> dict:
+    """Validated flash-kernel kwargs from the ``attention_*`` lever knobs.
+
+    Returns {} when every lever is at its default (any ``attention_impl``
+    is fine then); raises ValueError — config validation, before any
+    trace — when a lever is set without ``attention_impl="flash"`` (a
+    silently ignored knob is worse than an error) or carries a value the
+    kernel could never tile (the kernel itself would silently fall back
+    to XLA, hiding the typo).
+    """
+    levers = dict(block_q=cfg.attention_block_q,
+                  block_k=cfg.attention_block_k,
+                  bwd_block=cfg.attention_bwd_block)
+    if cfg.attention_bwd not in ("split", "fused"):
+        raise ValueError(f"attention_bwd must be 'split' or 'fused', "
+                         f"got {cfg.attention_bwd!r}")
+    set_levers = {k: v for k, v in levers.items() if v != 0}
+    if cfg.attention_bwd != "split":
+        set_levers["bwd_variant"] = cfg.attention_bwd
+    if not set_levers:
+        return {}
+    if cfg.attention_impl != "flash":
+        raise ValueError(
+            f"attention block/bwd levers ({', '.join(set_levers)}) tune "
+            f"the Pallas flash kernel and require attention_impl='flash', "
+            f"got {cfg.attention_impl!r}")
+    for name, mult in (("block_q", 8), ("block_k", 128),
+                       ("bwd_block", 128)):
+        v = levers[name]
+        if v < 0 or v % mult:
+            raise ValueError(
+                f"attention_{name}={v} invalid: must be a positive "
+                f"multiple of {mult} (Mosaic tile constraint) or 0 for "
+                f"the kernel default")
+    return set_levers
 
 
 # ---------------------------------------------------------------------------

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..config import TrainConfig
+from ..config import TrainConfig, flash_attention_kwargs
 from ..ops import losses, nn
 from ..ops.attention import multi_head_attention
 from ..parallel.mesh import AxisNames
@@ -88,7 +88,8 @@ class Bert:
     def __init__(self, cfg: BertConfig, dtype=jnp.float32,
                  attention_impl: str = "xla",
                  attention_fn: Callable | None = None,
-                 param_dtype=jnp.float32, remat: str = "none"):
+                 param_dtype=jnp.float32, remat: str = "none",
+                 attention_kwargs: dict | None = None):
         assert cfg.hidden % cfg.heads == 0
         if remat != "none" and remat not in REMAT_POLICIES:
             raise ValueError(f"remat must be one of "
@@ -97,6 +98,10 @@ class Bert:
         self.dtype = dtype
         self.param_dtype = param_dtype
         self.attention_impl = attention_impl
+        # flash-kernel tuning levers (block sizes / bwd variant), already
+        # validated by config.flash_attention_kwargs when built from a
+        # TrainConfig; {} = kernel defaults
+        self.attention_kwargs = dict(attention_kwargs or {})
         # override hook: e.g. make_ring_attention(mesh) for seq parallelism
         self.attention_fn = attention_fn
         self.remat = remat
@@ -171,7 +176,8 @@ class Bert:
         else:
             ctx = multi_head_attention(
                 q, k, v, mask=mask[:, None, None, :],
-                impl=self.attention_impl)
+                impl=self.attention_impl,
+                flash_kwargs=self.attention_kwargs or None)
         ctx = ctx.reshape(b, s, c.hidden)
         return nn.dense(p["o"], ctx, dtype=self.dtype)
 
@@ -345,7 +351,8 @@ def _make(config: TrainConfig, cfg: BertConfig, *,
     return (cls or Bert)(cfg, dtype=resolve_dtype(config.dtype),
                          attention_impl=config.attention_impl,
                          param_dtype=resolve_dtype(config.param_dtype),
-                         remat=config.remat)
+                         remat=config.remat,
+                         attention_kwargs=flash_attention_kwargs(config))
 
 
 @register_model("bert")

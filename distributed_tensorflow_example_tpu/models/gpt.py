@@ -38,7 +38,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..config import TrainConfig
+from ..config import TrainConfig, flash_attention_kwargs
 from ..ops import losses, nn
 from ..ops.attention import multi_head_attention
 from ..parallel.mesh import AxisNames
@@ -81,7 +81,8 @@ class GPT:
     def __init__(self, cfg: GPTConfig, dtype=jnp.float32,
                  attention_impl: str = "xla", attention_fn=None,
                  param_dtype=jnp.float32, remat: str = "none",
-                 decode_attention_impl: str = "auto"):
+                 decode_attention_impl: str = "auto",
+                 attention_kwargs: dict | None = None):
         assert cfg.hidden % cfg.heads == 0
         if remat != "none" and remat not in REMAT_POLICIES:
             raise ValueError(f"remat must be one of "
@@ -93,6 +94,10 @@ class GPT:
         self.dtype = dtype
         self.param_dtype = param_dtype
         self.attention_impl = attention_impl
+        # flash-kernel tuning levers (block sizes / bwd variant), already
+        # validated by config.flash_attention_kwargs when built from a
+        # TrainConfig; {} = kernel defaults
+        self.attention_kwargs = dict(attention_kwargs or {})
         # decode fast path: single-query Pallas attention over the cache
         # slab ("auto" = kernel on TPU at tile-friendly shapes, XLA
         # otherwise; see ops/pallas/decode_attention.py)
@@ -173,7 +178,8 @@ class GPT:
         else:
             ctx = multi_head_attention(
                 q, k, v, mask=mask[:, None, None, :], causal=True,
-                impl=self.attention_impl)
+                impl=self.attention_impl,
+                flash_kwargs=self.attention_kwargs or None)
         a = nn.dense(lp["attn"]["o"], ctx.reshape(b, s, c.hidden),
                      dtype=self.dtype)
         if use_dropout:
@@ -791,7 +797,8 @@ def _make(config: TrainConfig, cfg: GPTConfig, *,
     return GPT(cfg, dtype=resolve_dtype(config.dtype),
                attention_impl=config.attention_impl,
                param_dtype=resolve_dtype(config.param_dtype),
-               remat=config.remat)
+               remat=config.remat,
+               attention_kwargs=flash_attention_kwargs(config))
 
 
 @register_model("gpt")

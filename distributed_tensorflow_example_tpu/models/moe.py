@@ -52,10 +52,11 @@ class MoeBert(Bert):
 
     def __init__(self, cfg: MoeBertConfig, dtype=jnp.float32,
                  attention_impl: str = "xla", attention_fn=None,
-                 param_dtype=jnp.float32, remat: str = "none"):
+                 param_dtype=jnp.float32, remat: str = "none",
+                 attention_kwargs: dict | None = None):
         super().__init__(cfg, dtype=dtype, attention_impl=attention_impl,
                          attention_fn=attention_fn, param_dtype=param_dtype,
-                         remat=remat)
+                         remat=remat, attention_kwargs=attention_kwargs)
         self.cfg: MoeBertConfig = cfg
 
     def _is_moe_layer(self, i: int) -> bool:

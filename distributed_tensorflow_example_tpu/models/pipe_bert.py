@@ -168,7 +168,9 @@ class PipeBert(Bert):
         k = split(nn.dense(ap["k"], h_full, dtype=self.dtype))
         v = split(nn.dense(ap["v"], h_full, dtype=self.dtype))
         ctx = multi_head_attention(q, k, v, mask=mask[:, None, None, :],
-                                   impl=self.attention_impl)
+                                   impl=self.attention_impl,
+                                   flash_kwargs=self.attention_kwargs
+                                   or None)
         ctx = ctx.reshape(b, s, d_local)
         a = _row_dense_scatter(ap["o"], ctx, tp_axis, dtype=self.dtype)
         if use_dropout:

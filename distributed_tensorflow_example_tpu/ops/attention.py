@@ -20,7 +20,7 @@ Shape convention: [batch, seq, heads, head_dim] (BSHD) throughout.
 from __future__ import annotations
 
 import math
-from typing import Any
+from typing import Any, Mapping
 
 import jax
 import jax.numpy as jnp
@@ -62,7 +62,9 @@ def apply_mask(scores: jax.Array, mask: jax.Array | None,
 def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                          mask: jax.Array | None = None,
                          causal: bool = False,
-                         impl: str = "xla") -> jax.Array:
+                         impl: str = "xla",
+                         flash_kwargs: Mapping[str, Any] | None = None,
+                         ) -> jax.Array:
     """[B,S,H,D] qkv -> [B,S,H,D] context. Softmax in f32.
 
     Fully-masked query rows (no valid key) return ZEROS under every impl:
@@ -70,10 +72,19 @@ def multi_head_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     and the xla path zeroes them explicitly (plain softmax over an all-
     NEG_INF row would return the uniform average of V instead). This keeps
     impl= a drop-in swap at padded rows.
+
+    ``flash_kwargs``: kernel tuning levers (block_q/block_k/bwd_block/
+    bwd_variant — see :func:`.pallas.flash_attention.flash_attention`);
+    only meaningful with ``impl="flash"``, rejected loudly otherwise.
     """
     if impl == "flash":
         from .pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, mask=mask, causal=causal)
+        return flash_attention(q, k, v, mask=mask, causal=causal,
+                               **(flash_kwargs or {}))
+    if flash_kwargs:
+        raise ValueError(
+            f"flash_kwargs {sorted(flash_kwargs)} tune the Pallas kernel "
+            f"and require impl='flash', got impl={impl!r}")
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
     scores = attention_scores(q, k)

@@ -6,9 +6,30 @@ the grid* (the innermost, sequential grid dimension walks K blocks while
 the online-softmax state — running max ``m``, normalizer ``l``, output
 accumulator — lives in VMEM scratch that persists across grid steps). The
 backward recomputes probabilities blockwise from the saved per-row
-logsumexp ``L``: the dq kernel streams K blocks, the dk/dv kernel streams
-Q/dO blocks — the standard flash-attention-2 decomposition, with both
-operand streams O(block) as well.
+logsumexp ``L``.
+
+Backward variants (``bwd_variant``):
+
+- ``"split"`` (default, the round-2 kernel): the standard
+  flash-attention-2 decomposition — a dq kernel streaming K blocks and a
+  dk/dv kernel streaming Q/dO blocks, both operand streams O(block). Each
+  kernel recomputes the score block ``s = qk^T`` and the ``dp = do v^T``
+  block, so the pair does 7 block matmuls per (q, k) block pair and
+  streams every operand twice.
+- ``"fused"``: ONE kernel (grid walks k blocks outer, q blocks inner)
+  computes dk, dv AND dq in a single pass — s/p/dp/ds are computed once
+  and feed all three gradients (5 block matmuls per pair, ~29% fewer bwd
+  matmul FLOPs, and K/V are not re-streamed by a second kernel). The dq
+  accumulator is a full [S, head_dim] f32 VMEM slab (contributions for a
+  q block arrive once per OUTER k step, so no O(block) scratch can hold
+  them); the variant therefore engages only while the slab fits VMEM
+  (``_FUSED_SLAB_LIMIT``) and falls back to ``"split"`` beyond — at
+  S=4096, D=64 the slab is 1 MiB.
+
+Block sizes are levers, not constants: ``block_q``/``block_k`` set the
+forward tiles, ``bwd_block`` (one value for both streamed dims) the
+backward tiles; ``config.TrainConfig`` exposes all of them next to
+``attention_impl`` and ``experiments/flash_sweep.py`` sweeps them.
 
 Layout: inputs [B, S, H, D] (the framework's BSHD convention) are folded to
 [B*H, S, D] so the grid is (batch·head, q/k block, k/q block) and every
@@ -44,6 +65,57 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or \
     pltpu.TPUCompilerParams
 
 DEFAULT_BLOCK = 128
+
+#: fused-bwd dq slab budget: the [S, head_dim] f32 accumulator must share
+#: VMEM (~16 MiB less operand blocks) with the streamed tiles; past this
+#: the fused variant silently degrades to "split" (same math, same
+#: gradients — an availability boundary like ``_tile_friendly``, not an
+#: error)
+_FUSED_SLAB_LIMIT = 8 * 2**20
+
+BWD_VARIANTS = ("split", "fused")
+
+#: block matmuls per (q, k) block pair, by phase: the forward does qk^T
+#: and pv; the split backward recomputes s and dp in BOTH of its kernels
+#: (dq: s, dp, dq; dkv: s, dv, dp, dk); the fused backward computes each
+#: once. Basis for ``attention_train_flops``.
+_FWD_MATMULS = 2
+_BWD_MATMULS = {"split": 7, "fused": 5}
+
+
+def effective_bwd_variant(seq: int, head_dim: int,
+                          bwd_variant: str = "split") -> str:
+    """The backward variant that actually EXECUTES for these shapes:
+    "fused" degrades to "split" when the dq slab would not fit VMEM
+    (``_FUSED_SLAB_LIMIT``). Shared with the MFU accounting — counting
+    5 fused matmuls while the 7-matmul split runs would understate
+    analytic FLOPs by ~22% exactly where long-S comparability matters.
+    """
+    if bwd_variant == "fused" and seq * head_dim * 4 > _FUSED_SLAB_LIMIT:
+        return "split"
+    return bwd_variant
+
+
+def attention_train_flops(batch: int, seq: int, hidden: int, layers: int,
+                          *, causal: bool = False,
+                          bwd_variant: str = "split") -> float:
+    """Closed-form fwd+bwd FLOPs of the flash kernels for one train step.
+
+    XLA cost analysis cannot see inside a Pallas custom call, so gate MFU
+    for flash configs must add this analytically (VERDICT r5 weak #1).
+    Each block matmul contracts [S, D] x [D, S] per head per batch element
+    — 2·B·S²·hidden FLOPs summed over heads — and the kernel structure
+    fixes the matmul count per phase (``_FWD_MATMULS``/``_BWD_MATMULS``).
+    Causal grids skip blocks strictly above the diagonal: the live
+    fraction is (nk+1)/(2·nk) ≈ 0.5, counted as exactly 0.5 (the +1/2nk
+    diagonal sliver is below measurement noise at the gate shapes).
+    """
+    if bwd_variant not in _BWD_MATMULS:
+        raise ValueError(f"bwd_variant must be one of {BWD_VARIANTS}, "
+                         f"got {bwd_variant!r}")
+    units = _FWD_MATMULS + _BWD_MATMULS[bwd_variant]
+    total = units * 2.0 * batch * float(seq) ** 2 * hidden * layers
+    return total * (0.5 if causal else 1.0)
 
 
 def _interpret() -> bool:
@@ -235,13 +307,124 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, mask_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, L_ref, D_ref, mask_ref,
+                      dq_ref, dk_ref, dv_ref, dq_slab, dk_scr, dv_scr, *,
+                      blk_q: int, blk_k: int, nq: int, nk: int,
+                      causal: bool, sm_scale: float):
+    """One-pass backward: grid (BH, nk, nq), BOTH block dims sequential.
+
+    For each (k block, q block) pair the score/probability/ds blocks are
+    computed ONCE and feed dk, dv (O(block) scratch over the inner q
+    walk, as in the split dkv kernel) and dq (accumulated into the full
+    [S, D] f32 ``dq_slab`` — a q block's contributions arrive once per
+    OUTER k step, ascending, which matches the split dq kernel's
+    accumulation order exactly, so the two variants agree bit-for-bit).
+    The dq output block is the whole [S, D] slab with a constant index
+    map: Pallas copies it out once per batch-head, not per grid step.
+    """
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_start = qi * blk_q
+
+    @pl.when(ki == 0)
+    def _init_dq():
+        dq_slab[pl.dslice(q_start, blk_q), :] = jnp.zeros(
+            (blk_q, dq_slab.shape[1]), jnp.float32)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = ((qi + 1) * blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        Lrow, Drow = L_ref[0], D_ref[0]                   # [blk_q, 1]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        mrow = mask_ref[0] if mask_ref is not None else None
+        s = _block_mask(s, mrow, causal, q_start, ki * blk_k,
+                        blk_q, blk_k)
+        p = jnp.exp(s - Lrow) * (s > NEG_INF / 2)         # [blk_q, blk_k]
+        dv_scr[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # p.T @ do
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - Drow) * sm_scale
+        dk_scr[...] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # ds.T @ q
+        dq_slab[pl.dslice(q_start, blk_q), :] += jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize_kv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    @pl.when(ki == nk - 1)
+    def _finalize_dq():
+        dq_ref[0, pl.dslice(q_start, blk_q), :] = dq_slab[
+            pl.dslice(q_start, blk_q), :].astype(dq_ref.dtype)
+
+
+def _bwd_fused(q3, k3, v3, do3, L, Dsum, mask2, *, heads: int, blk_q: int,
+               blk_k: int, causal: bool):
+    bh, s, d = q3.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    nq, nk = s // blk_q, s // blk_k
+
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, j, 0))
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, i, 0))
+    rowspec = pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, j, 0))
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    args = [q3, k3, v3, do3, L, Dsum]
+    kw = dict(blk_q=blk_q, blk_k=blk_k, nq=nq, nk=nk, causal=causal,
+              sm_scale=sm_scale)
+    if mask2 is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, blk_k), lambda b, i, j: (b // heads, 0, i)))
+        args.append(mask2[:, None, :])
+        kernel = functools.partial(_bwd_fused_kernel, **kw)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, dor, lr, dr, dq, dk, dv, s0, s1, s2, **k:
+            _bwd_fused_kernel(qr, kr, vr, dor, lr, dr, None, dq, dk, dv,
+                              s0, s1, s2, **k), **kw)
+    dq, dk, dv = pl.pallas_call(
+        kernel, grid=(bh, nk, nq), in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, s, d), lambda b, i, j: (b, 0, 0)),
+                   pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+                   jax.ShapeDtypeStruct(k3.shape, k3.dtype),
+                   jax.ShapeDtypeStruct(v3.shape, v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return dq, dk, dv
+
+
 def _bwd(q3, k3, v3, o3, do3, L, mask2, *, heads: int, blk_q: int,
-         blk_k: int, causal: bool):
+         blk_k: int, causal: bool, variant: str = "split"):
     bh, s, d = q3.shape
     sm_scale = 1.0 / math.sqrt(d)
     nq, nk = s // blk_q, s // blk_k
     Dsum = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                    axis=-1, keepdims=True)                # [BH, S, 1]
+    if variant == "fused":
+        return _bwd_fused(q3, k3, v3, do3, L, Dsum, mask2, heads=heads,
+                          blk_q=blk_q, blk_k=blk_k, causal=causal)
 
     # dq: grid (BH, nq, nk) — K/V streamed innermost
     qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
@@ -308,23 +491,25 @@ def _bwd(q3, k3, v3, o3, do3, L, mask2, *, heads: int, blk_q: int,
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(heads: int, blk_q: int, blk_k: int, causal: bool,
-                has_mask: bool):
-    kw = dict(heads=heads, blk_q=blk_q, blk_k=blk_k, causal=causal)
+def _make_flash(heads: int, blk_q: int, blk_k: int, bwd_q: int, bwd_k: int,
+                bwd_variant: str, causal: bool, has_mask: bool):
+    fwd_kw = dict(heads=heads, blk_q=blk_q, blk_k=blk_k, causal=causal)
+    bwd_kw = dict(heads=heads, blk_q=bwd_q, blk_k=bwd_k, causal=causal,
+                  variant=bwd_variant)
 
     @jax.custom_vjp
     def fn(q3, k3, v3, mask2):
-        o, _ = _fwd(q3, k3, v3, mask2 if has_mask else None, **kw)
+        o, _ = _fwd(q3, k3, v3, mask2 if has_mask else None, **fwd_kw)
         return o
 
     def fwd(q3, k3, v3, mask2):
-        o, L = _fwd(q3, k3, v3, mask2 if has_mask else None, **kw)
+        o, L = _fwd(q3, k3, v3, mask2 if has_mask else None, **fwd_kw)
         return o, (q3, k3, v3, o, L, mask2)
 
     def bwd(res, do3):
         q3, k3, v3, o3, L, mask2 = res
         dq, dk, dv = _bwd(q3, k3, v3, o3, do3, L,
-                          mask2 if has_mask else None, **kw)
+                          mask2 if has_mask else None, **bwd_kw)
         dmask = jnp.zeros_like(mask2) if mask2 is not None else None
         return dq, dk, dv, dmask
 
@@ -342,26 +527,68 @@ def _tile_friendly(s: int, d: int, blk_q: int, blk_k: int) -> bool:
             and (d == 64 or d % 128 == 0))
 
 
+def _resolve_blocks(s: int, block_q: int, block_k: int,
+                    bwd_block: int) -> tuple[int, int, int, int]:
+    """(fwd_q, fwd_k, bwd_q, bwd_k) clamped to the sequence length; a
+    zero ``bwd_block`` inherits the forward tiles."""
+    blk_q, blk_k = min(block_q, s), min(block_k, s)
+    if bwd_block:
+        bwd_q = bwd_k = min(bwd_block, s)
+    else:
+        bwd_q, bwd_k = blk_q, blk_k
+    return blk_q, blk_k, bwd_q, bwd_k
+
+
+def kernel_engages(seq: int, head_dim: int, *,
+                   block_q: int = DEFAULT_BLOCK,
+                   block_k: int = DEFAULT_BLOCK,
+                   bwd_block: int = 0) -> bool:
+    """True iff these shapes/blocks take the Pallas kernel path (vs the
+    XLA fallback). Shared with bench.py's MFU accounting: analytic
+    attention FLOPs must be added exactly when the custom call (which
+    XLA cost analysis cannot see into) actually runs."""
+    blk_q, blk_k, bwd_q, bwd_k = _resolve_blocks(seq, block_q, block_k,
+                                                 bwd_block)
+    return (_tile_friendly(seq, head_dim, blk_q, blk_k)
+            and _tile_friendly(seq, head_dim, bwd_q, bwd_k))
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     mask: jax.Array | None = None, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK,
-                    block_k: int = DEFAULT_BLOCK) -> jax.Array:
+                    block_k: int = DEFAULT_BLOCK,
+                    bwd_block: int = 0,
+                    bwd_variant: str = "split") -> jax.Array:
     """Drop-in for ``multi_head_attention(impl="xla")``: [B,S,H,D] in/out.
 
     ``mask``: [B,S] key-validity (1 = attend) or broadcastable [B,1,1,S].
-    Falls back to the XLA path for tile-unfriendly shapes (see
-    ``_tile_friendly``).
+    ``block_q``/``block_k`` tile the forward grid; ``bwd_block`` (0 =
+    inherit the forward tiles) tiles BOTH streamed dims of the backward;
+    ``bwd_variant`` picks the split (two-kernel) or fused (one-kernel)
+    backward — see the module docstring. Falls back to the XLA path for
+    tile-unfriendly shapes (see ``_tile_friendly``); nonsensical lever
+    values (non-positive blocks, unknown variant) raise instead of
+    silently falling back.
     """
+    if block_q <= 0 or block_k <= 0 or bwd_block < 0:
+        raise ValueError(
+            f"block_q/block_k must be positive and bwd_block >= 0, got "
+            f"block_q={block_q} block_k={block_k} bwd_block={bwd_block}")
+    if bwd_variant not in BWD_VARIANTS:
+        raise ValueError(f"bwd_variant must be one of {BWD_VARIANTS}, "
+                         f"got {bwd_variant!r}")
     b, s, h, d = q.shape
-    blk_q = min(block_q, s)
-    blk_k = min(block_k, s)
-    if not _tile_friendly(s, d, blk_q, blk_k):
+    blk_q, blk_k, bwd_q, bwd_k = _resolve_blocks(s, block_q, block_k,
+                                                 bwd_block)
+    if not (_tile_friendly(s, d, blk_q, blk_k)
+            and _tile_friendly(s, d, bwd_q, bwd_k)):
         from ..attention import multi_head_attention
         m4 = None
         if mask is not None:
             m4 = mask if mask.ndim == 4 else mask[:, None, None, :]
         return multi_head_attention(q, k, v, mask=m4, causal=causal,
                                     impl="xla")
+    bwd_variant = effective_bwd_variant(s, d, bwd_variant)
 
     if mask is not None and mask.ndim == 4:
         mask = mask[:, 0, 0, :]
@@ -369,7 +596,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    fn = _make_flash(h, blk_q, blk_k, causal, mask is not None)
+    fn = _make_flash(h, blk_q, blk_k, bwd_q, bwd_k, bwd_variant, causal,
+                     mask is not None)
     mask2 = (mask.astype(jnp.int32) if mask is not None
              else jnp.ones((b, s), jnp.int32))
     o3 = fn(fold(q), fold(k), fold(v), mask2)

@@ -93,20 +93,20 @@ def measure(batch: int, max_new: int, *, reps=7, warmup=2,
     from bench import _run_decode
 
     gen_kwargs = LEVERS[lever] if lever else None
-    tps, token_step_ms, bound_ms, spread, suspect = _run_decode(
+    row = _run_decode(
         batch=batch, prompt=PROMPT if not tiny else 16,
         max_new=max_new, reps=reps, warmup=warmup, tiny=tiny,
         gen_kwargs=gen_kwargs)
     out = {
         "batch": batch, "prompt": PROMPT if not tiny else 16,
         "max_new": max_new,
-        "gen_ms": round(token_step_ms * max_new, 1),
-        "token_step_ms": round(token_step_ms, 3),
-        "tokens_per_s_chip": round(tps),
+        "gen_ms": round(row["token_step_ms"] * max_new, 1),
+        "token_step_ms": round(row["token_step_ms"], 3),
+        "tokens_per_s_chip": round(row["tokens_s_chip"]),
         # naive bound: every param (bf16) read once per token-step
-        "weight_bound_ms": round(bound_ms, 3),
-        "spread": round(spread, 4),
-        "suspect": suspect,
+        "weight_bound_ms": round(row["weight_bound_ms"], 3),
+        "spread": round(row["spread"], 4),
+        "suspect": row["suspect"],
     }
     if lever:
         import jax

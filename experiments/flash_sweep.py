@@ -1,0 +1,363 @@
+#!/usr/bin/env python
+"""Flash-attention kernel lever sweep + long-context analytic roofline
+(VERDICT r5 missing #1 / weak #2, ISSUE 2 tentpole).
+
+The long-context gate family (bert_long/gpt_long, S=4096 b4) is the one
+family with no profile and no analytic bound: the gate numbers imply
+~10% of peak with nothing explaining the other 90%, and the prime
+suspect is the Pallas flash kernel's hardwired DEFAULT_BLOCK=128 grid —
+~2 MFLOP per grid-step matmul, ~1.8M grid steps per train step at the
+gate shape (see ``--roofline``), small enough that Mosaic per-step
+overhead plausibly dominates. ``block_q``/``block_k``/``bwd_block``/
+``bwd_variant`` existed as parameters no caller ever varied; they are
+now plumbed through config/CLI (``--attention_block_q`` etc.) and this
+script sweeps them.
+
+Modes (one JSON line per measured cell; fresh process per cell via
+``--all`` — the round-4 lesson: long-lived processes through the axon
+tunnel accumulate timing artifacts):
+
+  --roofline         analytic model, runs anywhere: dense + attention
+                     FLOPs, kernel HBM streaming bytes AS A FUNCTION OF
+                     BLOCK SIZE, grid-step counts, and the implied
+                     MXU/HBM/overhead floors per (S, block, variant).
+                     The committed PROFILE_r06_bert_long.txt is this
+                     output plus the measured-gap discussion.
+  cell MODEL S IMPL [BLOCK] [VARIANT] [BWD_BLOCK]
+                     one measured cell on the current backend: step
+                     time, eps/chip, temp/peak MiB, MFU (analytic basis
+                     when the kernel engages). IMPL: xla | flash.
+                     Records OOM as an error line — the flash-vs-XLA
+                     crossover table needs the OOM rows too.
+  --all              the committed grid: MODEL x S in {512, 1024, 2048,
+                     4096} x (xla + flash blocks {128, 256, 512} x
+                     bwd {split, fused}); b=4 long-context batch.
+  --trace DIR MODEL  5-step profiler capture of the S=4096 b4 gate
+                     step (reduce with utils.trace_summary into
+                     PROFILE_r06_<model>_long.txt).
+
+The measured columns are TPU columns: off-TPU the kernels run in Pallas
+interpret mode (orders of magnitude slow, numbers meaningless), so
+--all/--cell refuse to print a table row off-TPU unless FLASH_SWEEP_CPU=1
+(CI smoke only). --roofline is platform-independent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODELS = ("bert", "gpt")
+SEQS = (512, 1024, 2048, 4096)
+BLOCKS = (128, 256, 512)
+VARIANTS = ("split", "fused")
+BATCH = 4                      # the long-context gate batch
+PEAK_FLOPS = 197e12            # v5e bf16
+HBM_BPS = 819e9                # v5e
+#: Mosaic per-grid-step overhead bracket (µs) for the predicted-floor
+#: column: TPU kernel-dispatch folklore puts sequential-grid step cost
+#: at a few hundred ns to ~1 µs; the sweep MEASURES where reality sits.
+OVERHEAD_US = (0.3, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# analytic model — every formula mirrors the kernel/model structure
+# ---------------------------------------------------------------------------
+
+def model_shapes(model: str) -> dict:
+    # bert-base / gpt-small bodies are the same trunk shape
+    return dict(hidden=768, layers=12, heads=12, head_dim=64,
+                intermediate=3072, vocab=30522,
+                max_predictions=20 if model == "bert" else None)
+
+
+def dense_train_flops(model: str, b: int, s: int) -> float:
+    """Exact matmul FLOPs of the non-attention trunk for one train step
+    (fwd x3: backward costs 2x forward for matmuls). Embedding gathers
+    and elementwise chains are excluded — they are byte-, not
+    FLOP-bound."""
+    m = model_shapes(model)
+    h, i, L, v = m["hidden"], m["intermediate"], m["layers"], m["vocab"]
+    per_layer_fwd = (4 * 2 * b * s * h * h        # QKV + O projections
+                     + 2 * 2 * b * s * h * i)     # FFN in/out
+    trunk = 3.0 * per_layer_fwd * L
+    if model == "bert":
+        t = b * m["max_predictions"]              # masked positions only
+        head = 3.0 * (2 * t * h * h + 2 * t * h * v)
+    else:
+        # full-vocab logits chain; the gate config chunks the loss at
+        # S=4096 (lm_loss_chunk=512): logits fwd + checkpoint recompute
+        # + bwd = 4x one pass
+        head = 4.0 * 2 * b * s * h * v
+    return trunk + head
+
+
+def attn_stream_bytes(b: int, s: int, heads: int, d: int, blk_q: int,
+                      blk_k: int, bwd_block: int, variant: str,
+                      *, op_bytes: int = 2) -> float:
+    """HBM bytes the flash kernels move per train step PER LAYER — the
+    block-size-controlled term. K/V do not fit VMEM at long S, so the
+    fwd grid re-fetches them once per Q block (nq times); the split
+    backward re-streams K/V again (dq kernel) AND Q/dO nk times (dkv
+    kernel); the fused backward drops the K/V re-stream. Row/output
+    traffic (Q, O, lse, dq/dk/dv writes) is streamed once and included.
+    """
+    bq, bk = (bwd_block or blk_q), (bwd_block or blk_k)
+    bh = b * heads
+    sd = bh * s * d * op_bytes                    # one full Q/K/V/O pass
+    nq, nk = s // blk_q, s // blk_k
+    nq_b, nk_b = s // bq, s // bk
+    fwd = sd * (1 + 1) + sd * 2 * nq + bh * s * 4          # Q,O + K,V + lse
+    if variant == "split":
+        dq = sd * (2 + 1) + sd * 2 * nq_b                  # Q,dO,dq + K,V
+        dkv = sd * (2 + 2) + sd * 2 * nk_b                 # K,V,dk,dv + Q,dO
+        bwd = dq + dkv
+    else:
+        bwd = sd * (2 + 3) + sd * 2 * nk_b     # K,V once; dq,dk,dv; Q,dO
+    return fwd + bwd
+
+
+def grid_steps(b: int, s: int, heads: int, blk_q: int, blk_k: int,
+               bwd_block: int, variant: str) -> int:
+    """Grid steps per train step per layer. NOTE: causal saves ~half the
+    FLOPs but none of these steps — dead blocks still pay the per-step
+    cost (the @pl.when guard skips compute, not the step)."""
+    bq, bk = (bwd_block or blk_q), (bwd_block or blk_k)
+    bh = b * heads
+    fwd = bh * (s // blk_q) * (s // blk_k)
+    bwd = bh * (s // bq) * (s // bk)
+    return fwd + bwd * (2 if variant == "split" else 1)
+
+
+def roofline_row(model: str, b: int, s: int, blk: int, variant: str,
+                 bwd_block: int = 0) -> dict:
+    from distributed_tensorflow_example_tpu.ops.pallas.flash_attention \
+        import attention_train_flops
+
+    m = model_shapes(model)
+    causal = model == "gpt"
+    dense = dense_train_flops(model, b, s)
+    attn = attention_train_flops(b, s, m["hidden"], m["layers"],
+                                 causal=causal, bwd_variant=variant)
+    stream = m["layers"] * attn_stream_bytes(
+        b, s, m["heads"], m["head_dim"], blk, blk, bwd_block, variant)
+    steps = m["layers"] * grid_steps(b, s, m["heads"], blk, blk,
+                                     bwd_block, variant)
+    mxu_ms = (dense + attn) / PEAK_FLOPS * 1e3
+    hbm_ms = stream / HBM_BPS * 1e3
+    ovh_ms = tuple(round(steps * us / 1e3, 1) for us in OVERHEAD_US)
+    return {
+        "model": model, "seq": s, "batch": b, "block": blk,
+        "bwd_variant": variant,
+        "dense_TF": round(dense / 1e12, 2),
+        "attn_TF": round(attn / 1e12, 2),
+        "attn_stream_GB": round(stream / 1e9, 1),
+        "grid_steps_k": round(steps / 1e3),
+        "mxu_floor_ms": round(mxu_ms, 1),
+        "attn_hbm_floor_ms": round(hbm_ms, 1),
+        "overhead_ms_at_0.3_1.0us": ovh_ms,
+    }
+
+
+def roofline() -> None:
+    print("# Analytic long-context roofline (v5e: 197 TFLOP/s bf16, "
+          "819 GB/s HBM)")
+    print("# dense/attn TF = executed TFLOP per train step; "
+          "attn_stream_GB = kernel HBM bytes (block-controlled); "
+          "grid_steps_k = Pallas grid steps (overhead-controlled)")
+    for model in MODELS:
+        for s in SEQS:
+            for blk in BLOCKS:
+                for variant in VARIANTS:
+                    print(json.dumps(roofline_row(model, BATCH, s, blk,
+                                                  variant)))
+
+
+# ---------------------------------------------------------------------------
+# measured cells
+# ---------------------------------------------------------------------------
+
+def measure(model_name: str, seq: int, impl: str, block: int,
+            variant: str, bwd_block: int, *, batch=BATCH, steps=6,
+            warmup=2) -> dict:
+    import time
+
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import (DataConfig,
+                                                           OptimizerConfig,
+                                                           TrainConfig)
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.ops.pallas.flash_attention \
+        import (attention_train_flops, effective_bwd_variant,
+                kernel_engages)
+    from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh
+    from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+        SyncReplicas)
+    from distributed_tensorflow_example_tpu.train.optimizers import (
+        make_optimizer)
+
+    # shared with the gate: ONE batch-builder and ONE timing
+    # implementation (decode_roofline.py:88 principle) — sweep cells
+    # must measure exactly what the bench rows measure
+    from bench import _gpt_batch_at, _long_batch, robust_time
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu and not os.environ.get("FLASH_SWEEP_CPU"):
+        raise SystemExit("measured cells are TPU cells (interpret-mode "
+                         "Pallas timings are meaningless); set "
+                         "FLASH_SWEEP_CPU=1 for a CI smoke run")
+    cfg = TrainConfig(model=model_name, dtype="bfloat16",
+                      data=DataConfig(batch_size=batch, seq_len=seq),
+                      optimizer=OptimizerConfig(name="adamw",
+                                                learning_rate=1e-4),
+                      attention_impl=impl, remat="none",
+                      attention_block_q=block if impl == "flash" else 0,
+                      attention_block_k=block if impl == "flash" else 0,
+                      attention_bwd_block=bwd_block,
+                      attention_bwd=variant if impl == "flash" else "split",
+                      lm_loss_chunk=512 if model_name == "gpt" else None)
+    model = get_model(model_name, cfg)
+    mesh = build_mesh()
+    sync = SyncReplicas(model.loss, make_optimizer(cfg.optimizer), mesh)
+    state = sync.init(model.init, seed=0,
+                      prng_impl="rbg" if on_tpu else None)
+    make_batch = _gpt_batch_at(seq) if model_name == "gpt" else _long_batch
+    placed = sync.shard_batch(make_batch(model, batch, 0))
+    compiled = sync.step.lower(state, placed).compile()
+    ma = compiled.memory_analysis()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    flops = float(ca.get("flops", 0.0))
+    basis = "cost_analysis"
+    ms = model_shapes(model_name)
+    # add the in-kernel FLOPs only when the kernel ENGAGES — on the XLA
+    # fallback (non-tileable shape) cost_analysis already counts the
+    # attention einsums and adding the analytic number would double-count
+    # (and over-raise robust_time's impossibility floor)
+    if impl == "flash" and kernel_engages(
+            seq, ms["head_dim"], block_q=block, block_k=block,
+            bwd_block=bwd_block):
+        flops += attention_train_flops(
+            batch, seq, ms["hidden"], ms["layers"],
+            causal=model_name == "gpt",
+            # count what EXECUTES: fused degrades to split past the
+            # VMEM slab limit
+            bwd_variant=effective_bwd_variant(seq, ms["head_dim"],
+                                              variant))
+        basis = "analytic"
+
+    # one untimed priming step binds the metrics for loss_finite even at
+    # warmup=0 (the --trace window), then the remaining warmup
+    state, m_ = compiled(state, placed)
+    for _ in range(max(0, warmup - 1)):
+        state, m_ = compiled(state, placed)
+    jax.block_until_ready(state.params)
+
+    def timed():
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m_ = compiled(state, placed)
+        jax.block_until_ready(state.params)
+        return time.perf_counter() - t0
+
+    # bench.robust_time rejects the tunnel's corrupt-fast readings via
+    # the analytic-FLOP impossibility check and flags what it cannot fix
+    # — a suspect cell must never pick the winning block for the gate
+    # re-base (PROFILE_r06 §5 decision rule)
+    dt, suspect = robust_time(timed, steps=steps, flops=flops or None,
+                              peak=PEAK_FLOPS if on_tpu else None)
+    step_ms = dt / steps * 1e3
+    return {
+        "model": model_name, "seq": seq, "impl": impl,
+        "block": block if impl == "flash" else None,
+        "bwd_variant": variant if impl == "flash" else None,
+        "bwd_block": bwd_block or None,
+        "step_ms": round(step_ms, 1),
+        "eps_chip": round(batch / (dt / steps), 2),
+        # CPU jax builds lack the peak stat; 0 = unavailable, not "fits"
+        "temp_MiB": round(getattr(ma, "temp_size_in_bytes", 0) / 2**20),
+        "peak_MiB": round(getattr(ma, "peak_memory_in_bytes", 0) / 2**20),
+        "mfu": round(flops / (dt / steps) / PEAK_FLOPS, 4) if flops
+        else None,
+        "mfu_basis": basis,
+        "loss_finite": bool(np.isfinite(float(jax.device_get(
+            m_["loss"])))),
+        "suspect": bool(suspect),
+    }
+
+
+def trace(outdir: str, model_name: str) -> dict:
+    """5-step xplane capture of the S=4096 b4 gate config (block/variant
+    defaults) — reduce with utils.trace_summary for the PROFILE
+    artifact."""
+    import jax
+
+    # warm the compilation cache with one measured pass, then capture a
+    # fresh 5-step window (the second call re-uses the persistent cache)
+    out = measure(model_name, 4096, "flash", 128, "split", 0,
+                  steps=5, warmup=3)
+    jax.profiler.start_trace(outdir)
+    try:
+        measure(model_name, 4096, "flash", 128, "split", 0, steps=5,
+                warmup=0)
+    finally:
+        jax.profiler.stop_trace()   # never leave the profiler running
+    return {"trace": outdir, "model": model_name, "warm_cell": out}
+
+
+def main() -> None:
+    if sys.argv[1:2] == ["--roofline"]:
+        roofline()
+        return
+    if sys.argv[1:2] == ["--all"]:
+        env = dict(os.environ,
+                   DTX_JAX_CACHE=os.environ.get("DTX_JAX_CACHE",
+                                                "/tmp/dtx_jax_cache"))
+        me = os.path.abspath(__file__)
+        for mn in MODELS:
+            for s in SEQS:
+                cells = [("xla", 0, "split", 0)]
+                cells += [("flash", blk, var, 0) for blk in BLOCKS
+                          for var in VARIANTS]
+                # the wider-block split-dkv probe at the gate shape
+                if s == 4096:
+                    cells.append(("flash", 128, "split", 512))
+                for impl, blk, var, bb in cells:
+                    subprocess.run(
+                        [sys.executable, me, "cell", mn, str(s), impl,
+                         str(blk), var, str(bb)], env=env, check=False)
+        return
+    if sys.argv[1:2] == ["--trace"]:
+        outdir, mn = sys.argv[2], sys.argv[3]
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("DTX_JAX_CACHE",
+                                         "/tmp/dtx_jax_cache"))
+        print(json.dumps(trace(outdir, mn)), flush=True)
+        return
+    if sys.argv[1:2] != ["cell"]:
+        raise SystemExit(__doc__)
+    mn, s, impl = sys.argv[2], int(sys.argv[3]), sys.argv[4]
+    blk = int(sys.argv[5]) if len(sys.argv) > 5 else 128
+    var = sys.argv[6] if len(sys.argv) > 6 else "split"
+    bb = int(sys.argv[7]) if len(sys.argv) > 7 else 0
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("DTX_JAX_CACHE", "/tmp/dtx_jax_cache"))
+    try:
+        print(json.dumps(measure(mn, s, impl, blk, var, bb)), flush=True)
+    except Exception as e:  # noqa: BLE001 — OOM at compile is a finding
+        print(json.dumps({"model": mn, "seq": s, "impl": impl,
+                          "block": blk, "bwd_variant": var,
+                          "error": f"{type(e).__name__}: {str(e)[:200]}"}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

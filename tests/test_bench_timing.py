@@ -127,3 +127,58 @@ def test_vs_baseline_excludes_suspect_measurements():
     # all-suspect -> neutral 1.0, not a crash
     allbad = {"mnist_mlp_eps_chip": 5000.0, "mnist_mlp_suspect": True}
     assert bench.vs_baseline_geomean(allbad, base) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# decode de-noising (round 6): the two-point device-component fit and
+# the gate's preference for it over tunnel-jittered wall-clock
+# ---------------------------------------------------------------------------
+
+def test_decode_device_component_fit():
+    """Synthetic generation times on the measured model gen_s =
+    0.099 + 0.00084*new (BASELINE.md decode roofline): the fit must
+    recover the slope (device ms/token) and intercept (call overhead)."""
+    from bench import decode_device_component
+
+    t128 = 0.099 + 0.00084 * 128
+    t512 = 0.099 + 0.00084 * 512
+    dev_ms, overhead_ms = decode_device_component(t128, t512, 128, 512)
+    assert dev_ms == pytest.approx(0.84)
+    assert overhead_ms == pytest.approx(99.0)
+
+
+def test_decode_device_component_rejects_bad_lengths():
+    from bench import decode_device_component
+
+    with pytest.raises(ValueError, match="new_long > new_short"):
+        decode_device_component(0.2, 0.2, 128, 128)
+
+
+def test_decode_gate_prefers_device_component():
+    """Once BOTH baseline and measurement carry the device component,
+    the gpt_decode ratio rides it (inverted: ms, lower is faster) and
+    tunnel jitter in wall-clock tokens/s cannot move the gate; without
+    the baseline key the row falls back to wall-clock tokens/s."""
+    from bench import vs_baseline_geomean
+
+    base = {"gpt_decode_tokens_s_chip": 5000,
+            "gpt_decode_device_token_ms": 0.84}
+    # wall-clock halved by a tunnel hiccup, device component unchanged
+    extra = {"gpt_decode_tokens_s_chip": 2500,
+             "gpt_decode_device_token_ms": 0.84}
+    assert vs_baseline_geomean(extra, base) == pytest.approx(1.0)
+    # device component regresses 20% -> the gate sees it
+    worse = dict(extra, gpt_decode_device_token_ms=1.05)
+    assert vs_baseline_geomean(worse, base) == pytest.approx(0.8)
+    # no device baseline yet -> wall-clock fallback (pre-re-base rounds)
+    legacy_base = {"gpt_decode_tokens_s_chip": 5000}
+    assert vs_baseline_geomean(extra, legacy_base) == pytest.approx(0.5)
+    # suspect flag still excludes the row entirely
+    sus = dict(extra, gpt_decode_suspect=True,
+               gpt_decode_device_token_ms=0.001)
+    assert vs_baseline_geomean(sus, base) == 1.0
+    # a NEGATIVE slope (corrupt long leg that dodged the suspect flag)
+    # must not reach the geomean as a negative ratio (NaN): the row
+    # falls back to wall-clock
+    neg = dict(extra, gpt_decode_device_token_ms=-0.2)
+    assert vs_baseline_geomean(neg, base) == pytest.approx(0.5)

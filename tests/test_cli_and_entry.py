@@ -62,6 +62,7 @@ def test_cli_unknown_dataset_errors():
         main(["--model=mlp", "--dataset=nope", "--train_steps=1"])
 
 
+@pytest.mark.slow   # full driver-contract run: entry compile + 8-dev dryrun
 def test_graft_entry_contract():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -73,6 +74,7 @@ def test_graft_entry_contract():
     g.dryrun_multichip(4)
 
 
+@pytest.mark.slow   # subprocess re-exec with a poisoned default backend
 def test_dryrun_multichip_hermetic():
     """The driver calls dryrun_multichip in an env we don't control — no
     XLA_FLAGS, no JAX_PLATFORMS, possibly a broken default accelerator

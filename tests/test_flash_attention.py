@@ -1,4 +1,12 @@
-"""Pallas flash attention vs reference attention (interpret mode on CPU)."""
+"""Pallas flash attention vs reference attention (interpret mode on CPU).
+
+Two shape regimes on purpose: the tiny-D tests (D=32, block_k=32 — not
+Mosaic-tileable) exercise the silent XLA fallback boundary; the
+kernel-path tests (D=64, S % 128 == 0, block_k % 128 == 0) run the REAL
+kernels in interpret mode, including the round-6 lever surface
+(non-default blocks, bwd_block, the fused backward) and its loud
+config-validation failures.
+"""
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +16,7 @@ import pytest
 from distributed_tensorflow_example_tpu.ops.attention import (
     multi_head_attention)
 from distributed_tensorflow_example_tpu.ops.pallas.flash_attention import (
-    flash_attention)
+    attention_train_flops, flash_attention, kernel_engages)
 
 B, S, H, D = 2, 64, 2, 32
 BLK = dict(block_q=32, block_k=32)
@@ -104,3 +112,211 @@ def test_bert_with_flash_attention_matches_xla():
     lx, _ = m_x.loss(params, {}, batch, jax.random.key(1))
     lf, _ = m_f.loss(params, {}, batch, jax.random.key(1))
     np.testing.assert_allclose(float(lx), float(lf), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# lever surface (round 6): kernel-path shapes — the Pallas kernels
+# ACTUALLY run here (interpret mode), no fallback
+# ---------------------------------------------------------------------------
+
+KS, KD = 256, 64          # S % 128 == 0, D == 64: Mosaic-tileable
+
+
+def _qkv_kernel(seed=0):
+    rs = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rs.randn(B, KS, H, KD).astype(np.float32)
+                             * 0.4) for _ in range(3))
+
+
+@pytest.mark.parametrize("kw", [
+    dict(block_q=64, block_k=128),
+    dict(block_q=32, block_k=128, bwd_block=256),
+    dict(block_q=256, block_k=256),
+    dict(block_q=128, block_k=128, bwd_variant="fused"),
+])
+def test_kernel_path_nondefault_blocks_match_xla(kw):
+    q, k, v = _qkv_kernel(10)
+    assert kernel_engages(KS, KD, **{a: b for a, b in kw.items()
+                                     if a != "bwd_variant"})
+    for causal in (False, True):
+        want = multi_head_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, **kw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("kw", [dict(bwd_block=256),
+                                dict(bwd_variant="fused")])
+def test_bwd_lever_grads_match_xla(causal, kw):
+    """The wider-block split bwd and the fused bwd are real gradient
+    paths, not just forward levers."""
+    q, k, v = _qkv_kernel(11)
+
+    def loss(fn, **fkw):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, **fkw) ** 2)
+
+    ref = jax.grad(loss(multi_head_attention, causal=causal),
+                   argnums=(0, 1, 2))(q, k, v)
+    fl = jax.grad(loss(flash_attention, causal=causal, block_q=64,
+                       block_k=128, **kw), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ref, fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_matches_split_bitwise(causal):
+    """The fused backward accumulates each gradient in the same order as
+    the split kernels (dq over ascending k blocks, dk/dv over ascending
+    q blocks) with identical per-block math, so the variants must agree
+    BIT-FOR-BIT — any drift means the fused kernel recomputes s/p/ds
+    differently than the oracle."""
+    q, k, v = _qkv_kernel(12)
+
+    def grads(**kw):
+        return jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=128, **kw) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads(), grads(bwd_variant="fused")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_bwd_matches_split_bitwise_masked():
+    q, k, v = _qkv_kernel(13)
+    mask = np.ones((B, KS), np.int32)
+    mask[:, 200:] = 0
+    m = jnp.asarray(mask)
+
+    def grads(**kw):
+        return jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, mask=m, block_q=64, block_k=128,
+            **kw)[:, :200] ** 2), argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(grads(), grads(bwd_variant="fused")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_attention_train_flops_closed_form():
+    """9 block-matmul units split (2 fwd + 7 bwd), 7 fused (2 + 5);
+    causal halves; each unit is 2·B·S²·hidden·layers."""
+    unit = 2 * 2 * 128 ** 2 * 64 * 3
+    split = attention_train_flops(2, 128, 64, 3)
+    assert split == 9 * unit
+    assert attention_train_flops(2, 128, 64, 3,
+                                 bwd_variant="fused") == 7 * unit
+    assert attention_train_flops(2, 128, 64, 3, causal=True) == 4.5 * unit
+    with pytest.raises(ValueError, match="bwd_variant"):
+        attention_train_flops(2, 128, 64, 3, bwd_variant="bogus")
+
+
+def test_effective_bwd_variant_degrades_past_vmem_slab():
+    """fused needs an [S, D] f32 dq slab in VMEM: past the limit it
+    executes as split — and the MFU accounting must count the split
+    matmul count, not the requested variant's."""
+    from distributed_tensorflow_example_tpu.ops.pallas.flash_attention \
+        import effective_bwd_variant
+
+    assert effective_bwd_variant(4096, 64, "fused") == "fused"
+    assert effective_bwd_variant(65536, 64, "fused") == "split"
+    assert effective_bwd_variant(65536, 64, "split") == "split"
+
+
+def test_kernel_engages_matches_fallback_boundary():
+    assert kernel_engages(256, 64)
+    assert not kernel_engages(256, 32)          # head dim not MXU-aligned
+    assert not kernel_engages(250, 64)          # S not divisible
+    assert not kernel_engages(256, 64, block_k=96)
+    # a bwd_block the sequence can't tile disables the kernel path too
+    # (at S=256 it would be CLAMPED to 256 and engage; at S=512 the
+    # clamp is a no-op and 512 % 384 != 0 kills the path)
+    assert kernel_engages(256, 64, bwd_block=384)
+    assert not kernel_engages(512, 64, bwd_block=384)
+
+
+def test_invalid_lever_values_raise():
+    q, k, v = _qkv_kernel(14)
+    with pytest.raises(ValueError, match="positive"):
+        flash_attention(q, k, v, block_q=0)
+    with pytest.raises(ValueError, match="bwd_block"):
+        flash_attention(q, k, v, bwd_block=-128)
+    with pytest.raises(ValueError, match="bwd_variant"):
+        flash_attention(q, k, v, bwd_variant="bogus")
+
+
+# ---------------------------------------------------------------------------
+# config -> call-site plumbing + loud config validation
+# ---------------------------------------------------------------------------
+
+def test_config_blocks_reach_kernel(monkeypatch):
+    """TrainConfig lever knobs must arrive at the kernel call unchanged
+    — the whole point of the plumbing is that a sweep is reproducible
+    from flags, so a dropped kwarg is a silent sweep-invalidator."""
+    import importlib
+
+    from distributed_tensorflow_example_tpu.config import TrainConfig
+    from distributed_tensorflow_example_tpu.models import get_model
+
+    # the package __init__ re-exports the function under the module's
+    # name, so import the MODULE explicitly to patch its attribute
+    fa_mod = importlib.import_module(
+        "distributed_tensorflow_example_tpu.ops.pallas.flash_attention")
+
+    seen: dict = {}
+
+    def spy(q, k, v, *, mask=None, causal=False, **kw):
+        seen.update(kw, causal=causal)
+        return jnp.zeros_like(q)
+
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    cfg = TrainConfig(model="gpt_tiny", attention_impl="flash",
+                      attention_block_q=256, attention_block_k=256,
+                      attention_bwd_block=512, attention_bwd="fused")
+    m = get_model("gpt_tiny", cfg)
+    m.loss(m.init(jax.random.key(0)), {}, m.dummy_batch(2),
+           jax.random.key(1))
+    assert seen == dict(block_q=256, block_k=256, bwd_block=512,
+                        bwd_variant="fused", causal=True)
+
+
+def test_config_validation_fails_loudly():
+    from distributed_tensorflow_example_tpu.config import (
+        TrainConfig, flash_attention_kwargs)
+
+    assert flash_attention_kwargs(TrainConfig()) == {}
+    with pytest.raises(ValueError, match="attention_impl='flash'"):
+        flash_attention_kwargs(TrainConfig(attention_block_q=256))
+    with pytest.raises(ValueError, match="multiple of 8"):
+        flash_attention_kwargs(TrainConfig(attention_impl="flash",
+                                           attention_block_q=12))
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention_kwargs(TrainConfig(attention_impl="flash",
+                                           attention_block_k=64))
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention_kwargs(TrainConfig(attention_impl="flash",
+                                           attention_bwd_block=100))
+    with pytest.raises(ValueError, match="attention_bwd"):
+        flash_attention_kwargs(TrainConfig(attention_impl="flash",
+                                           attention_bwd="bogus"))
+
+
+def test_cli_flags_map_to_config():
+    from distributed_tensorflow_example_tpu.cli.train import (
+        build_parser, config_from_args)
+
+    args = build_parser().parse_args(
+        ["--model", "gpt", "--attention", "flash",
+         "--attention_block_q", "256", "--attention_block_k", "512",
+         "--attention_bwd_block", "512", "--attention_bwd", "fused"])
+    cfg = config_from_args(args)
+    assert (cfg.attention_block_q, cfg.attention_block_k,
+            cfg.attention_bwd_block, cfg.attention_bwd) == \
+        (256, 512, 512, "fused")
+
+
+def test_flash_kwargs_rejected_by_xla_impl():
+    q, k, v = _qkv_kernel(15)
+    with pytest.raises(ValueError, match="impl='flash'"):
+        multi_head_attention(q, k, v, impl="xla",
+                             flash_kwargs={"block_q": 256})

@@ -27,6 +27,11 @@ import pytest
 
 from _cluster_harness import run_two_process
 
+# multi-minute on the gate machine: a real two-process jax.distributed
+# cluster spawn per test — the tier-1 fast lane (-m "not slow") skips
+# these; the full suite remains the pre-ship gate
+pytestmark = pytest.mark.slow
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _WORKER = os.path.join(_DIR, "_two_process_worker.py")
 
