@@ -12,10 +12,12 @@ devices are present (the driver runs it on one real TPU chip):
 - ``bert_large``  — the big dense model, b64
 - ``bert_long``   — composed long context: S=4096 flash, b4 (remat=none
   since the round-5 sweep — BASELINE.md "Round-5 remat sweep")
-- ``gpt_small``   — causal-LM train, s512 b32 (VERDICT r4 task #2)
-- ``gpt_long``    — causal long context: S=4096 causal flash + chunked
-  LM loss, b4 (queued-dispatch methodology like bert_long — the round-4
-  reliability defect is resolved, BASELINE.md GPT row)
+- ``gpt_small``   — causal-LM train, s512 b32, fused blockwise LM loss
+  (``lm_loss_impl="fused"`` since round 7 — BASELINE.md "Vocab chain")
+- ``gpt_long``    — causal long context: S=4096 causal flash + fused
+  LM loss, b4 (fused replaced ``lm_loss_chunk=512`` in round 7: no
+  [B,S,V] logits AND no seq-chunk recompute; queued-dispatch
+  methodology like bert_long)
 - ``gpt_decode``  — KV-cache greedy decode, b8 prompt 128 + 128 new;
   tokens/s/chip via the one-dispatch compiled generation, riding the
   stacked-scan decode fast path (models/gpt.py decode_impl="stacked":
@@ -37,6 +39,12 @@ augmented number feeds robust_time's physical-impossibility check. The
 reference publishes no numbers (BASELINE.md), so ``bench_baseline.json``
 holds this repo's own first measurements; ``vs_baseline`` is
 measured/baseline of the headline metric (>1 is faster).
+
+Every training row also publishes ``{key}_peak_mib`` (XLA memory-
+analysis peak for the compiled step, when the backend reports it) so
+memory levers — the fused LM loss killing the [B,S,V] logits
+residency, remat, storage dtypes — are regression-visible columns, not
+folklore.
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
@@ -82,6 +90,22 @@ def _chip_peak() -> float | None:
         if key in kind:
             return peak
     return None
+
+
+def _peak_mib(compiled) -> float | None:
+    """XLA memory-analysis peak for one compiled step, in MiB (None when
+    the backend doesn't report it — CPU builds often return 0). The
+    published ``{key}_peak_mib`` column is what makes memory levers
+    (``--lm_loss_impl fused`` killing the [B,S,V] logits residency,
+    remat, bf16 storage) regression-visible, not folklore."""
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        peak = getattr(ma, "peak_memory_in_bytes", 0)
+        return peak / 2**20 if peak else None
+    except Exception:
+        return None
 
 
 def _step_flops(compiled) -> float | None:
@@ -226,8 +250,10 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
          cfg_over: dict | None = None,
          steps_per_call: int = 1, prng_impl: str | None = None):
     """Time `steps` sync steps; returns (examples/sec/chip, step_ms, mfu,
-    suspect) — ``suspect`` marks a measurement robust_time could not
-    de-corrupt (callers surface it, never publish it as real).
+    mfu_basis, peak_mib, suspect) — ``peak_mib`` is the compiled step's
+    XLA memory-analysis peak (None when unreported) and ``suspect``
+    marks a measurement robust_time could not de-corrupt (callers
+    surface it, never publish it as real).
 
     ``steps_per_call > 1`` uses the device-side multi-step loop
     (iterations_per_loop) — essential for latency-bound microbenchmarks
@@ -262,6 +288,7 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     # compile does not populate the jit dispatch cache, so calling step_fn
     # afterwards would compile the same program a second time
     compiled = step_fn.lower(state, placed).compile()
+    peak_mib = _peak_mib(compiled)
     flops = _step_flops(compiled)
     if flops and k > 1:
         flops /= k               # cost_analysis covers the whole K-step scan
@@ -291,7 +318,7 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     step_s = dt / steps
     eps_chip = batch / step_s / n_dev
     mfu = (flops / step_s / (peak * n_dev)) if (flops and peak) else None
-    return eps_chip, step_s * 1e3, mfu, mfu_basis, suspect
+    return eps_chip, step_s * 1e3, mfu, mfu_basis, peak_mib, suspect
 
 
 def _mnist_batch(model, batch, i):
@@ -498,18 +525,25 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
              extra_cfg={"seq_len": 4096 if on_tpu else 256},
              # remat=none since round 5: 36% faster at this shape and
              # fits in ~8.4 GiB of 16 (BASELINE.md "Round-5 remat
-             # sweep"; baseline re-based with a methodology note)
-             cfg_over={"attention_impl": "flash", "remat": "none"},
+             # sweep"; baseline re-based with a methodology note).
+             # lm_loss_impl=fused since round 7 (BASELINE.md "Vocab
+             # chain"): the MLM head rides the blockwise core — a
+             # composition row at M=80 positions, not a win
+             cfg_over={"attention_impl": "flash", "remat": "none",
+                       "lm_loss_impl": "fused"},
              prng_impl=rbg, eps_digits=2),
         dict(key="gpt_small", only={"gpt", "gpt_small"},
              model="gpt" if on_tpu else "gpt_tiny",
              batch=max(8, 32 // scale), steps=20 if on_tpu else 2,
              warmup=5 if on_tpu else 1, opt=adamw,
              make_batch=_gpt_batch_at(512 if on_tpu else 128),
-             # chunk=0: the free 2% at b32 where the full logits fit
-             # (BASELINE.md GPT profile); --lm_loss_chunk remains the
-             # bigger-shape enabler
+             # fused LM loss since round 7: the ~21 ms/step vocab chain
+             # (logits fwd/bwd + tied-embed grad + softmax reductions +
+             # accuracy argmax — BASELINE.md "Vocab chain") collapses
+             # to the blockwise scan; the full-logits path stays the
+             # parity oracle, re-base rule pre-committed in BASELINE.md
              extra_cfg={"seq_len": 512 if on_tpu else 128},
+             cfg_over={"lm_loss_impl": "fused"},
              prng_impl=rbg),
         dict(key="gpt_long", only={"gpt_long"},
              model="gpt" if on_tpu else "gpt_tiny",
@@ -517,8 +551,11 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
              warmup=2 if on_tpu else 1, opt=adamw,
              make_batch=_gpt_batch_at(4096 if on_tpu else 128),
              extra_cfg={"seq_len": 4096 if on_tpu else 128},
+             # fused since round 7: replaces lm_loss_chunk=512 — no
+             # [B,S,V] tensor AND no seq-chunk recompute (the chunk
+             # knob survives as the fallback; BASELINE.md "Vocab chain")
              cfg_over={"attention_impl": "flash", "remat": "none",
-                       "lm_loss_chunk": 512 if on_tpu else 64},
+                       "lm_loss_impl": "fused"},
              prng_impl=rbg, eps_digits=2),
         # reps=7: median-of-repeats de-noising (VERDICT r5 weak #4) —
         # odd count gives a true middle element, 7 keeps the row under
@@ -618,7 +655,7 @@ def main() -> None:
             if row["suspect"]:
                 extra[f"{key}_suspect"] = True
             continue
-        eps, ms, mfu, mfu_basis, suspect = _run(
+        eps, ms, mfu, mfu_basis, peak_mib, suspect = _run(
             w["model"], batch=w["batch"], steps=w["steps"],
             warmup=w["warmup"], opt=w["opt"],
             make_batch=w["make_batch"],
@@ -630,6 +667,8 @@ def main() -> None:
         if mfu:
             extra[f"{key}_mfu"] = round(mfu, 4)
             extra[f"{key}_mfu_basis"] = mfu_basis
+        if peak_mib:
+            extra[f"{key}_peak_mib"] = round(peak_mib)
         if suspect:
             extra[f"{key}_suspect"] = True
 

@@ -22,7 +22,8 @@ from ..cluster import ClusterSpec, WORKER_JOB
 from ..config import (CheckpointConfig, DataConfig, MeshShape,
                       ObservabilityConfig, OptimizerConfig, SyncConfig,
                       TrainConfig, add_legacy_flags,
-                      flash_attention_kwargs, parse_hosts)
+                      flash_attention_kwargs, lm_loss_settings,
+                      parse_hosts)
 from ..utils.logging import get_logger
 
 log = get_logger("cli")
@@ -143,11 +144,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_jitter", type=float, default=None,
                    help="MoE models: router input noise amplitude "
                         "U[1-j, 1+j], training only (typ. 0.01)")
+    p.add_argument("--lm_loss_impl", default=None,
+                   choices=["full", "chunked", "fused"],
+                   help="LM-head loss strategy (gpt/bert families): "
+                        "full = materialize [B,S,vocab] logits (parity "
+                        "oracle / kill switch); chunked = seq chunks "
+                        "under jax.checkpoint (gpt only; needs "
+                        "--lm_loss_chunk); fused = blockwise vocab scan "
+                        "with custom VJP — the logits tensor never "
+                        "exists in fwd or bwd and token_accuracy rides "
+                        "the same pass (default: full, or chunked when "
+                        "--lm_loss_chunk is set)")
+    p.add_argument("--lm_loss_vocab_block", type=int, default=None,
+                   help="fused LM loss: vocab tile of the blockwise "
+                        "scan (0 = the built-in default; swept by "
+                        "experiments/vocab_chain_sweep.py); requires "
+                        "--lm_loss_impl fused")
+    p.add_argument("--token_accuracy_every_n", type=int, default=1,
+                   help="gpt models: compute the per-step "
+                        "token_accuracy argmax only every n-th step on "
+                        "the full/chunked paths (costs ~3.2 ms/step at "
+                        "the 30k vocab — BASELINE.md; skipped steps "
+                        "publish -1.0; rejected with --lm_loss_impl "
+                        "fused, whose accuracy is free)")
     p.add_argument("--lm_loss_chunk", type=int, default=None,
                    help="gpt models: sequence-chunked LM loss — at most "
-                        "[B, chunk, vocab] logits resident (the full "
-                        "tensor OOMs long-context/big-batch causal "
-                        "training); must divide seq_len; 0 = full")
+                        "[B, chunk, vocab] logits resident; must divide "
+                        "seq_len; 0 = full. The pre-fused fallback: "
+                        "--lm_loss_impl fused removes the full tensor "
+                        "from both passes without the recompute")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="smooth training targets (image classifiers: "
                         "lenet/resnet20/resnet50; the standard ImageNet "
@@ -375,7 +400,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         moe_aux_weight=args.moe_aux_weight,
         moe_router_z_weight=args.moe_router_z_weight,
         moe_jitter=args.moe_jitter,
+        lm_loss_impl=args.lm_loss_impl,
         lm_loss_chunk=args.lm_loss_chunk,
+        lm_loss_vocab_block=args.lm_loss_vocab_block,
+        token_accuracy_every_n=args.token_accuracy_every_n,
         eval_every_steps=args.eval_every_steps,
         early_stop_metric=args.early_stop_metric,
         early_stop_patience=args.early_stop_patience,
@@ -643,12 +671,32 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(
             f"--lm_loss_chunk is a causal-LM knob (gpt/gpt_tiny), not "
             f"for model {args.model!r}")
+    # LM-head loss levers make sense only for the models whose loss IS
+    # an LM-head xent (causal GPT next-token; the BERT-family MLM heads
+    # — including the MoE/pipeline variants, which share Bert's head)
+    lm_head_model = args.model.startswith(
+        ("gpt", "bert", "moe_bert", "pipe_bert", "pipe_moe"))
+    if ((args.lm_loss_impl is not None
+         or args.lm_loss_vocab_block is not None)
+            and not lm_head_model):
+        raise SystemExit(
+            f"--lm_loss_impl/--lm_loss_vocab_block configure the LM-head "
+            f"cross-entropy (gpt/bert families), not for model "
+            f"{args.model!r}")
+    if args.token_accuracy_every_n != 1 and not args.model.startswith(
+            "gpt"):
+        raise SystemExit(
+            f"--token_accuracy_every_n is a causal-LM knob (gpt/"
+            f"gpt_tiny), not for model {args.model!r}")
     cfg = config_from_args(args)          # reused below for the run
     try:
         # fail fast on flash-lever misuse: levers without --attention
         # flash, or block values the kernel could never tile (it would
         # silently fall back to XLA, hiding the typo for a whole run)
         flash_attention_kwargs(cfg)
+        # ... and on LM-loss lever misuse: conflicting impl/chunk/block
+        # combinations that a model deep in the run would reject anyway
+        lm_loss_settings(cfg)
     except ValueError as e:
         raise SystemExit(str(e))
     if args.export_generator and not args.model.startswith("gpt"):

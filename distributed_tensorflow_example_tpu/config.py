@@ -226,7 +226,28 @@ class TrainConfig:
     moe_aux_weight: float | None = None  # load-balancing loss weight
     moe_router_z_weight: float | None = None   # ST-MoE router z-loss
     moe_jitter: float | None = None      # router noise U[1-j,1+j] (train)
-    lm_loss_chunk: int | None = None     # gpt: seq-chunked LM loss (0=full)
+    lm_loss_impl: str | None = None      # LM-head loss strategy for the
+                                         # language models (gpt*/bert
+                                         # families): full | chunked |
+                                         # fused (blockwise vocab scan,
+                                         # no [B,S,V] logits in fwd or
+                                         # bwd — ops/losses.py). None =
+                                         # the model default ("full";
+                                         # "chunked" when lm_loss_chunk
+                                         # is set — the legacy spelling)
+    lm_loss_chunk: int | None = None     # gpt: seq-chunked LM loss (0=full;
+                                         # the pre-fused fallback lever)
+    lm_loss_vocab_block: int | None = None  # fused: vocab tile (0 = the
+                                            # losses.DEFAULT_VOCAB_BLOCK;
+                                            # swept by experiments/
+                                            # vocab_chain_sweep.py)
+    token_accuracy_every_n: int = 1      # gpt: cadence of the per-step
+                                         # token_accuracy argmax on the
+                                         # full/chunked paths (measured
+                                         # 3.2 ms/step at the 30k vocab;
+                                         # skipped steps publish -1.0;
+                                         # rejected with impl=fused,
+                                         # whose accuracy is free)
     eval_every_steps: int = 0        # 0 => eval only at the end
     early_stop_metric: str | None = None  # stop when this eval metric
                                           # stops improving
@@ -315,6 +336,75 @@ def flash_attention_kwargs(cfg: TrainConfig) -> dict:
                 f"multiple of {mult} (Mosaic tile constraint) or 0 for "
                 f"the kernel default")
     return set_levers
+
+
+#: lm_loss_impl values lm_loss_settings accepts (mirrors
+#: ops.losses.LM_LOSS_IMPLS without importing jax at config time).
+LM_LOSS_IMPLS = ("full", "chunked", "fused")
+
+
+def lm_loss_settings(cfg: TrainConfig) -> dict:
+    """Validated, resolved LM-head loss settings from the ``lm_loss_*``
+    / ``token_accuracy_every_n`` knobs.
+
+    Returns ``{"impl", "chunk", "vocab_block", "accuracy_every_n"}``
+    with ``None`` defaults resolved (``impl=None`` means "full", or
+    "chunked" when ``lm_loss_chunk`` is set — the legacy spelling that
+    predates the impl knob). Raises ValueError — config validation,
+    before any trace — on values no path could honor or combinations
+    that would silently ignore a knob (worse than an error):
+    ``chunked`` without a chunk, an explicit non-chunked impl WITH a
+    chunk, a vocab block outside ``fused``, or negative sizes.
+    """
+    impl = cfg.lm_loss_impl
+    chunk = cfg.lm_loss_chunk
+    block = cfg.lm_loss_vocab_block
+    every = cfg.token_accuracy_every_n
+    if impl is not None and impl not in LM_LOSS_IMPLS:
+        raise ValueError(f"lm_loss_impl must be one of {LM_LOSS_IMPLS}, "
+                         f"got {impl!r}")
+    if chunk is not None and chunk < 0:
+        raise ValueError(f"lm_loss_chunk={chunk} must be >= 0")
+    if block is not None and block < 0:
+        raise ValueError(f"lm_loss_vocab_block={block} must be >= 0")
+    if every < 1:
+        raise ValueError(
+            f"token_accuracy_every_n={every} must be >= 1 (1 = the "
+            "default per-step argmax)")
+    if impl == "chunked" and not chunk:
+        raise ValueError(
+            "lm_loss_impl='chunked' needs lm_loss_chunk > 0 (the chunk "
+            "size; it must divide seq_len)")
+    if chunk and impl not in (None, "chunked"):
+        raise ValueError(
+            f"lm_loss_chunk={chunk} conflicts with lm_loss_impl="
+            f"{impl!r}: the chunk is the 'chunked' impl's lever (fused "
+            "never materializes the logits the chunk recompute bounds; "
+            "full materializes them whole)")
+    if block and impl != "fused":
+        raise ValueError(
+            f"lm_loss_vocab_block={block} tunes the fused vocab scan "
+            f"and requires lm_loss_impl='fused', got {impl!r}")
+    if every != 1 and impl == "fused":
+        raise ValueError(
+            f"token_accuracy_every_n={every} skips the full/chunked "
+            "paths' per-step argmax; the fused path computes accuracy "
+            "inside the same vocab scan at no extra cost — drop the "
+            "knob (a silently ignored knob is worse than an error)")
+    if every != 1 and cfg.sync.accum_steps > 1:
+        raise ValueError(
+            f"token_accuracy_every_n={every} does not compose with "
+            f"accum_steps={cfg.sync.accum_steps}: the loss runs once "
+            "per MICROBATCH, so the cadence counter would tick per "
+            "microbatch and the microbatch-mean of metrics would "
+            "average real accuracies with the -1.0 skipped sentinel "
+            "into a number that is neither")
+    return {
+        "impl": impl or ("chunked" if chunk else "full"),
+        "chunk": chunk or 0,
+        "vocab_block": block or 0,
+        "accuracy_every_n": every,
+    }
 
 
 # ---------------------------------------------------------------------------

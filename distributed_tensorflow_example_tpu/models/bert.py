@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..config import TrainConfig, flash_attention_kwargs
+from ..config import TrainConfig, flash_attention_kwargs, lm_loss_settings
 from ..ops import losses, nn
 from ..ops.attention import multi_head_attention
 from ..parallel.mesh import AxisNames
@@ -57,6 +57,14 @@ class BertConfig:
     type_vocab: int = 2
     dropout: float = 0.1
     max_predictions: int = 20     # masked positions per sequence (static)
+    #: MLM-head loss strategy (ops/losses.py lm_head_xent): "full"
+    #: materializes the [B, M, vocab] logits (M = max_predictions —
+    #: already small, so this is the default), "fused" routes through
+    #: the same blockwise vocab scan the causal LM uses (no [B, M, V]
+    #: tensor in fwd/bwd; parity-tested — composition coverage more
+    #: than a win at M≈20). "chunked" is causal-LM-only and rejected.
+    lm_loss_impl: str = "full"
+    lm_loss_vocab_block: int = 0  # fused: vocab tile (0 = default)
 
     @classmethod
     def base(cls) -> "BertConfig":
@@ -94,6 +102,19 @@ class Bert:
         if remat != "none" and remat not in REMAT_POLICIES:
             raise ValueError(f"remat must be one of "
                              f"{['none', *REMAT_POLICIES]}, got {remat!r}")
+        if cfg.lm_loss_impl not in ("full", "fused"):
+            raise ValueError(
+                "bert lm_loss_impl must be 'full' or 'fused' "
+                f"(got {cfg.lm_loss_impl!r}; 'chunked' chunks a causal "
+                "LM's sequence axis — the MLM head already touches only "
+                "max_predictions positions)")
+        if cfg.lm_loss_vocab_block < 0:
+            raise ValueError(f"lm_loss_vocab_block="
+                             f"{cfg.lm_loss_vocab_block} must be >= 0")
+        if cfg.lm_loss_vocab_block and cfg.lm_loss_impl != "fused":
+            raise ValueError(
+                f"lm_loss_vocab_block={cfg.lm_loss_vocab_block} tunes "
+                "the fused vocab scan and requires lm_loss_impl='fused'")
         self.cfg = cfg
         self.dtype = dtype
         self.param_dtype = param_dtype
@@ -248,19 +269,46 @@ class Bert:
             h = layer(params[f"layer_{i}"], h, mask, lrng)
         return h
 
-    def mlm_logits(self, params, seq_out, masked_positions):
-        """Gather masked positions and decode against the tied embedding.
-        [B,S,hid] + [B,M] -> [B,M,vocab]."""
+    def mlm_hidden(self, params, seq_out, masked_positions):
+        """Gather masked positions and run the MLM transform head:
+        [B,S,hid] + [B,M] -> [B,M,hid] f32 — the hidden stream the
+        tied-embedding decode (full or fused) consumes."""
         h = jnp.take_along_axis(seq_out, masked_positions[..., None], axis=1)
         h = nn.dense(params["mlm"]["transform"], h.astype(self.dtype),
                      dtype=self.dtype)
         h = jax.nn.gelu(h.astype(jnp.float32))
-        h = nn.layernorm(params["mlm"]["ln"], h)
+        return nn.layernorm(params["mlm"]["ln"], h)
+
+    def mlm_logits(self, params, seq_out, masked_positions):
+        """Decode masked positions against the tied embedding.
+        [B,S,hid] + [B,M] -> [B,M,vocab]."""
+        h = self.mlm_hidden(params, seq_out, masked_positions)
         table = params["embed"]["word"]["table"]   # tied decoder
         logits = jnp.einsum("bmh,vh->bmv", h.astype(self.dtype),
                             table.astype(self.dtype),
                             preferred_element_type=jnp.float32)
         return logits + params["mlm"]["bias"]
+
+    def _mlm_loss_and_acc(self, params, seq_out, batch, w):
+        """(masked-LM xent, accuracy) honoring ``cfg.lm_loss_impl`` —
+        ONE head-loss implementation for Bert and every subclass (the
+        MoE and pipeline variants call it too), riding the shared
+        blockwise core in ops/losses.py. ``w`` is the effective
+        per-prediction weight (masked_weights, already composed with
+        any ``__valid__`` eval-tail mask by the caller)."""
+        labels = batch["masked_labels"]
+        if self.cfg.lm_loss_impl == "fused":
+            h = self.mlm_hidden(params, seq_out,
+                                batch["masked_positions"])
+            return losses.lm_head_xent(
+                h, params["embed"]["word"]["table"], labels, w,
+                bias=params["mlm"]["bias"], impl="fused",
+                vocab_block=self.cfg.lm_loss_vocab_block,
+                dtype=self.dtype)
+        logits = self.mlm_logits(params, seq_out,
+                                 batch["masked_positions"])
+        nll, hit = losses.lm_nll_hits(logits, labels)
+        return losses.weighted_token_mean(nll, hit, w)
 
     # ------------------------------------------------------------------
     def apply(self, params, extras, batch, rng=None, train: bool = False):
@@ -269,31 +317,21 @@ class Bert:
         return logits, extras
 
     def loss(self, params, extras, batch, rng):
-        logits, new_extras = self.apply(params, extras, batch, rng,
-                                        train=True)
+        seq_out = self.encode(params, batch, rng, train=True)
         w = batch["masked_weights"].astype(jnp.float32)
-        loss = losses.softmax_xent_int_labels(
-            logits, batch["masked_labels"], where=w)
-        pred = jnp.argmax(logits, axis=-1)
-        acc = (jnp.sum((pred == batch["masked_labels"]) * w)
-               / jnp.maximum(jnp.sum(w), 1.0))
-        return loss, ({"mlm_accuracy": acc}, new_extras)
+        loss, acc = self._mlm_loss_and_acc(params, seq_out, batch, w)
+        return loss, ({"mlm_accuracy": acc}, extras)
 
     def eval_metrics(self, params, extras, batch) -> dict:
-        logits, _ = self.apply(params, extras, batch, train=False)
+        seq_out = self.encode(params, batch, train=False)
         w = batch["masked_weights"].astype(jnp.float32)
         valid = batch.get("__valid__")
         if valid is not None:
             # padded static-shape eval tail: zero out every token of a
             # padding example; composes with the per-token MLM weights
             w = w * valid.astype(jnp.float32)[:, None]
-        pred = jnp.argmax(logits, axis=-1)
-        return {
-            "loss": losses.softmax_xent_int_labels(
-                logits, batch["masked_labels"], where=w),
-            "mlm_accuracy": (jnp.sum((pred == batch["masked_labels"]) * w)
-                             / jnp.maximum(jnp.sum(w), 1.0)),
-        }
+        loss, acc = self._mlm_loss_and_acc(params, seq_out, batch, w)
+        return {"loss": loss, "mlm_accuracy": acc}
 
     # ------------------------------------------------------------------
     #: TP rules for the (non-stacked) embedding/MLM head — shared with
@@ -348,6 +386,12 @@ def _make(config: TrainConfig, cfg: BertConfig, *,
     # long-context runs size the position table by the requested seq_len
     # (--seq_len 4096 just works; the default max_len stays the floor)
     cfg.max_len = max(cfg.max_len, config.data.seq_len)
+    # LM-head loss lever (validated loudly before any trace; "chunked"
+    # resolves only from the causal-LM chunk knob, which the CLI rejects
+    # for bert models — Bert.__init__ re-rejects for direct users)
+    ls = lm_loss_settings(config)
+    cfg.lm_loss_impl = ls["impl"]
+    cfg.lm_loss_vocab_block = ls["vocab_block"]
     return (cls or Bert)(cfg, dtype=resolve_dtype(config.dtype),
                          attention_impl=config.attention_impl,
                          param_dtype=resolve_dtype(config.param_dtype),

@@ -38,7 +38,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..config import TrainConfig, flash_attention_kwargs
+from ..config import TrainConfig, flash_attention_kwargs, lm_loss_settings
 from ..ops import losses, nn
 from ..ops.attention import multi_head_attention
 from ..parallel.mesh import AxisNames
@@ -56,13 +56,24 @@ class GPTConfig:
     intermediate: int = 3072
     max_len: int = 1024
     dropout: float = 0.1
-    #: LM-loss sequence chunk: the [B, S, vocab] logits tensor is the
-    #: memory wall of causal-LM training (b64 s512 at the 30k vocab is
-    #: ~4 GB of f32 logits — measured OOM on the v5e chip). chunk > 0
-    #: computes logits + xent per seq chunk under jax.checkpoint, so at
-    #: most [B, chunk, vocab] is ever resident (backward recomputes per
-    #: chunk). 0 = single full-logits pass.
+    #: LM-loss execution strategy (ops/losses.py lm_head_xent). The
+    #: [B, S, vocab] logits tensor is the memory wall of causal-LM
+    #: training (b64 s512 at the 30k vocab is ~4 GB of f32 logits —
+    #: measured OOM on the v5e chip) AND ~21 ms of the 170 ms gpt_small
+    #: step (BASELINE.md "Vocab chain"): "full" materializes it (the
+    #: parity oracle and kill switch), "chunked" bounds residency at
+    #: [B, loss_chunk, vocab] via jax.checkpoint recompute (the legacy
+    #: escape hatch, now the fallback), "fused" never builds it in
+    #: either direction (blockwise vocab scan + custom VJP) and gets
+    #: token_accuracy from the same pass.
+    loss_impl: str = "full"
+    #: seq chunk for loss_impl="chunked" (must divide seq_len; > 0
+    #: with loss_impl="full" is accepted as the legacy spelling of
+    #: "chunked" — the pre-round-7 --lm_loss_chunk contract).
     loss_chunk: int = 0
+    #: vocab tile for loss_impl="fused" (0 = losses.DEFAULT_VOCAB_BLOCK;
+    #: swept by experiments/vocab_chain_sweep.py).
+    loss_vocab_block: int = 0
 
     @classmethod
     def small(cls) -> "GPTConfig":
@@ -82,7 +93,8 @@ class GPT:
                  attention_impl: str = "xla", attention_fn=None,
                  param_dtype=jnp.float32, remat: str = "none",
                  decode_attention_impl: str = "auto",
-                 attention_kwargs: dict | None = None):
+                 attention_kwargs: dict | None = None,
+                 accuracy_every_n: int = 1):
         assert cfg.hidden % cfg.heads == 0
         if remat != "none" and remat not in REMAT_POLICIES:
             raise ValueError(f"remat must be one of "
@@ -90,6 +102,60 @@ class GPT:
         if decode_attention_impl not in ("auto", "pallas", "xla"):
             raise ValueError(f"decode_attention_impl must be auto/pallas/"
                              f"xla, got {decode_attention_impl!r}")
+        # LM-loss lever validation, loud at model build (config-built
+        # models are additionally validated by config.lm_loss_settings
+        # before any trace):
+        if cfg.loss_impl not in losses.LM_LOSS_IMPLS:
+            raise ValueError(
+                f"lm_loss_impl must be one of {losses.LM_LOSS_IMPLS}, "
+                f"got {cfg.loss_impl!r}")
+        if cfg.loss_chunk < 0:
+            raise ValueError(
+                f"lm_loss_chunk={cfg.loss_chunk} must be >= 0")
+        if cfg.loss_vocab_block < 0:
+            raise ValueError(f"lm_loss_vocab_block={cfg.loss_vocab_block} "
+                             "must be >= 0")
+        if cfg.loss_chunk and cfg.loss_impl == "full":
+            # legacy spelling: loss_chunk alone meant "chunked" before
+            # the impl knob existed — honor it rather than silently
+            # ignoring the chunk (the knob's whole point is not OOMing)
+            cfg.loss_impl = "chunked"
+        if cfg.loss_impl == "chunked" and not cfg.loss_chunk:
+            raise ValueError("lm_loss_impl='chunked' needs lm_loss_chunk "
+                             "> 0 (the chunk size)")
+        if cfg.loss_impl == "fused" and cfg.loss_chunk:
+            raise ValueError(
+                "lm_loss_chunk conflicts with lm_loss_impl='fused': the "
+                "fused vocab scan never materializes full logits, so "
+                "there is nothing for the seq-chunk recompute to bound "
+                "— drop --lm_loss_chunk (or pick impl='chunked')")
+        if cfg.loss_vocab_block and cfg.loss_impl != "fused":
+            raise ValueError(
+                f"lm_loss_vocab_block={cfg.loss_vocab_block} tunes the "
+                f"fused vocab scan and requires lm_loss_impl='fused', "
+                f"got {cfg.loss_impl!r}")
+        if accuracy_every_n < 1:
+            raise ValueError(f"token_accuracy_every_n={accuracy_every_n} "
+                             "must be >= 1")
+        if accuracy_every_n != 1 and cfg.loss_impl == "fused":
+            # same loud contract as config.lm_loss_settings, for direct
+            # (non-config) construction: fused's accuracy is free, so
+            # the cadence knob would be silently inert
+            raise ValueError(
+                f"token_accuracy_every_n={accuracy_every_n} skips the "
+                "full/chunked paths' per-step argmax; lm_loss_impl="
+                "'fused' computes accuracy inside the same vocab scan "
+                "at no extra cost — drop the knob")
+        #: cadence of the per-step token_accuracy argmax on the
+        #: full/chunked paths (1 = every step; the fused path's argmax
+        #: is free and ignores this). n > 1 keeps a step counter in
+        #: TrainState.extras and skips the argmax on non-multiple steps
+        #: (token_accuracy then reads -1.0 — the skipped-metric
+        #: sentinel). Does NOT compose with microbatch accumulation
+        #: (the loss runs per microbatch and the metric mean would
+        #: blend real accuracies with the sentinel) —
+        #: config.lm_loss_settings rejects that combination.
+        self.accuracy_every_n = accuracy_every_n
         self.cfg = cfg
         self.dtype = dtype
         self.param_dtype = param_dtype
@@ -145,7 +211,18 @@ class GPT:
                 },
             }
         params["ln_f"] = nn.layernorm_init(c.hidden)
-        return cast_floating(params, self.param_dtype)
+        params = cast_floating(params, self.param_dtype)
+        if self.accuracy_every_n != 1:
+            # the every-n accuracy cadence needs a step counter the loss
+            # can read; extras is the framework slot for exactly this
+            # kind of non-trained state (f32 so shard_map's extras
+            # pmean is exact — equal values on every replica). NOTE the
+            # counter is part of the checkpoint layout: flipping the
+            # knob ON over an existing run's ckpt_dir fails loudly at
+            # restore ("checkpoint missing leaf extras/lm_step") —
+            # set it from the first step of a run, not mid-flight
+            return params, {"lm_step": jnp.zeros((), jnp.float32)}
+        return params
 
     # ------------------------------------------------------------------
     def _qkv(self, ap, h):
@@ -232,44 +309,21 @@ class GPT:
             params, self.encode(params, batch, rng, train)), extras
 
     # ------------------------------------------------------------------
-    def _chunked_lm_loss(self, params, h, targets, w, chunk: int):
-        """Sequence-chunked next-token loss: per chunk, compute the
-        [B, chunk, V] logits + xent and DROP them (jax.checkpoint), so
-        the full [B, S, V] tensor never exists in forward or backward.
-        ``h`` covers all S positions; ``targets``/``w`` are the S-1
-        shifted labels/weights — this helper pads them with a weight-0
-        dummy at position S-1 and validates divisibility, so loss() and
-        eval_metrics() share ONE setup. Returns (loss, accuracy) with
-        identical semantics to the full pass (weighted token mean)."""
-        b, s, hid = h.shape
-        if s % chunk:
-            raise ValueError(
-                f"loss_chunk={chunk} must divide seq_len={s} (a silent "
-                "full-logits fallback would OOM exactly the configs the "
-                "knob exists for)")
+    def _lm_loss(self, params, h, targets, w, *, accuracy: bool = True):
+        """Next-token loss + accuracy over encoded ``h`` [B, S, hid].
+        ``targets``/``w`` are the S-1 shifted labels/weights; ONE setup
+        pads them with a weight-0 dummy at position S-1 so every impl
+        (full / chunked / fused — ops/losses.py lm_head_xent, the shared
+        blockwise core) sees the same aligned [B, S] arrays. Returns
+        (loss, accuracy) as weighted token means."""
+        c = self.cfg
         targets = jnp.concatenate(
             [targets, jnp.zeros_like(targets[:, :1])], axis=1)
         w = jnp.concatenate([w, jnp.zeros_like(w[:, :1])], axis=1)
-        n = s // chunk
-        hs = h.reshape(b, n, chunk, hid).transpose(1, 0, 2, 3)
-        ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
-        ws = w.reshape(b, n, chunk).transpose(1, 0, 2)
-
-        @jax.checkpoint
-        def body(carry, xs):
-            hh, tt, ww = xs
-            logits = self.lm_logits(params, hh)
-            nll = losses.token_nll(logits, tt) * ww
-            hits = (jnp.argmax(logits, axis=-1) == tt) * ww
-            lsum, hsum, wsum = carry
-            return (lsum + jnp.sum(nll), hsum + jnp.sum(hits),
-                    wsum + jnp.sum(ww)), None
-
-        (lsum, hsum, wsum), _ = lax.scan(
-            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
-                   jnp.zeros((), jnp.float32)), (hs, ts, ws))
-        denom = jnp.maximum(wsum, 1.0)
-        return lsum / denom, hsum / denom
+        return losses.lm_head_xent(
+            h, params["wte"]["table"], targets, w, impl=c.loss_impl,
+            seq_chunk=c.loss_chunk, vocab_block=c.loss_vocab_block,
+            dtype=self.dtype, accuracy=accuracy)
 
     def loss(self, params, extras, batch, rng):
         # next-token prediction: position t predicts token t+1; padding
@@ -278,19 +332,24 @@ class GPT:
         mask = batch.get("attention_mask",
                          jnp.ones_like(batch["input_ids"]))
         w = mask[:, 1:].astype(jnp.float32)
-        chunk = self.cfg.loss_chunk
-        if chunk:
-            h = self.encode(params, batch, rng, train=True)
-            loss, acc = self._chunked_lm_loss(params, h, targets, w,
-                                              chunk)
+        h = self.encode(params, batch, rng, train=True)
+        every = self.accuracy_every_n
+        step = (extras.get("lm_step")
+                if every != 1 and isinstance(extras, dict) else None)
+        if every == 1 or self.cfg.loss_impl == "fused" or step is None:
+            # fused gets the argmax free from the same vocab scan; step
+            # is None for direct callers that never initialized the
+            # counter (init() emits it only when the knob is set)
+            loss, acc = self._lm_loss(params, h, targets, w)
             return loss, ({"token_accuracy": acc}, extras)
-        logits, new_extras = self.apply(params, extras, batch, rng,
-                                        train=True)
-        lg = logits[:, :-1]
-        loss = losses.softmax_xent_int_labels(lg, targets, where=w)
-        pred = jnp.argmax(lg, axis=-1)
-        acc = (jnp.sum((pred == targets) * w)
-               / jnp.maximum(jnp.sum(w), 1.0))
+        # every-n cadence: one branch runs per step (lax.cond), so the
+        # full-vocab argmax is genuinely skipped on non-multiple steps
+        loss, acc = lax.cond(
+            jnp.mod(step, float(every)) == 0,
+            lambda: self._lm_loss(params, h, targets, w, accuracy=True),
+            lambda: self._lm_loss(params, h, targets, w, accuracy=False))
+        new_extras = dict(extras)
+        new_extras["lm_step"] = step + 1.0
         return loss, ({"token_accuracy": acc}, new_extras)
 
     def eval_metrics(self, params, extras, batch) -> dict:
@@ -301,21 +360,12 @@ class GPT:
         valid = batch.get("__valid__")
         if valid is not None:
             w = w * valid.astype(jnp.float32)[:, None]
-        chunk = self.cfg.loss_chunk
-        if chunk:
-            # same memory wall as training: the final eval of a chunked
-            # run must not materialize the full [B, S, vocab] tensor the
-            # knob exists to avoid
-            h = self.encode(params, batch, train=False)
-            loss, acc = self._chunked_lm_loss(params, h, targets, w,
-                                              chunk)
-        else:
-            logits, _ = self.apply(params, extras, batch, train=False)
-            lg = logits[:, :-1]
-            pred = jnp.argmax(lg, axis=-1)
-            loss = losses.softmax_xent_int_labels(lg, targets, where=w)
-            acc = (jnp.sum((pred == targets) * w)
-                   / jnp.maximum(jnp.sum(w), 1.0))
+        # eval rides the configured impl too: the final eval of a
+        # chunked/fused run must not re-materialize the [B, S, vocab]
+        # tensor the lever exists to avoid — and always reports
+        # accuracy (the every-n knob is a per-train-step economy)
+        h = self.encode(params, batch, train=False)
+        loss, acc = self._lm_loss(params, h, targets, w)
         return {
             "loss": loss,
             # the classic LM headline number; exp of the masked mean xent
@@ -789,16 +839,18 @@ def _make(config: TrainConfig, cfg: GPTConfig, *,
     if config_vocab:
         cfg.vocab_size = config.data.vocab_size
     cfg.max_len = max(cfg.max_len, config.data.seq_len)
-    if config.lm_loss_chunk is not None:
-        if config.lm_loss_chunk < 0:
-            raise ValueError(
-                f"lm_loss_chunk={config.lm_loss_chunk} must be >= 0")
-        cfg.loss_chunk = config.lm_loss_chunk
+    # loud config-time validation of the LM-loss lever surface (impl /
+    # chunk / vocab block / accuracy cadence), before any trace
+    ls = lm_loss_settings(config)
+    cfg.loss_impl = ls["impl"]
+    cfg.loss_chunk = ls["chunk"]
+    cfg.loss_vocab_block = ls["vocab_block"]
     return GPT(cfg, dtype=resolve_dtype(config.dtype),
                attention_impl=config.attention_impl,
                param_dtype=resolve_dtype(config.param_dtype),
                remat=config.remat,
-               attention_kwargs=flash_attention_kwargs(config))
+               attention_kwargs=flash_attention_kwargs(config),
+               accuracy_every_n=ls["accuracy_every_n"])
 
 
 @register_model("gpt")

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..config import TrainConfig
-from ..ops import losses, moe, nn
+from ..ops import moe, nn
 from ..parallel.mesh import AxisNames
 from ..parallel.sharding import ShardingRules
 from .base import register_model, resolve_dtype
@@ -140,14 +140,11 @@ class MoeBert(Bert):
     # ------------------------------------------------------------------
     def loss(self, params, extras, batch, rng):
         seq_out, aux = self.encode_with_aux(params, batch, rng, train=True)
-        logits = self.mlm_logits(params, seq_out, batch["masked_positions"])
         new_extras = extras
         w = batch["masked_weights"].astype(jnp.float32)
-        mlm = losses.softmax_xent_int_labels(
-            logits, batch["masked_labels"], where=w)
-        pred = jnp.argmax(logits, axis=-1)
-        acc = (jnp.sum((pred == batch["masked_labels"]) * w)
-               / jnp.maximum(jnp.sum(w), 1.0))
+        # the MLM head loss is Bert's shared implementation (full or
+        # fused blockwise core per cfg.lm_loss_impl — ops/losses.py)
+        mlm, acc = self._mlm_loss_and_acc(params, seq_out, batch, w)
         total = (mlm + self.cfg.aux_weight * aux["lb_loss"]
                  + self.cfg.router_z_weight * aux["z_loss"])
         load = aux["expert_load"]

@@ -5,12 +5,31 @@ labels (SURVEY.md §2.1 'Model' row). All losses here reduce with a *mean*
 over the batch so that, under data sharding, the gradient all-reduce is a
 mean — matching the reference's explicit gradient averaging
 (sync_replicas_optimizer.py:36-40 note; SURVEY.md §7 hard-parts item 2).
+
+LM-head cross-entropy (the [B, S, V] logits chain) lives here too:
+:func:`lm_head_xent` is the ONE implementation of weight-tied-head
+softmax xent + token accuracy that every language model (GPT causal LM,
+BERT MLM and its MoE/pipe variants) calls, with three interchangeable
+impls — ``full`` (materialize logits: the parity oracle and kill
+switch), ``chunked`` (sequence chunks under ``jax.checkpoint``) and
+``fused`` (blockwise over the vocab with a custom VJP: the [.., V]
+logits tensor never exists in forward OR backward — Wijmans et al.,
+"Cut Your Losses in Large-Vocabulary Language Models", 2024; vocab-
+blocked reduction in the spirit of Megatron-LM's vocab-parallel xent).
+All three share the same post-logits numerics (:func:`lm_nll_hits` /
+the fused forward computes the identical quantities online), so parity
+is structural, not a kept-in-sync-by-comment contract.
 """
 
 from __future__ import annotations
 
+import functools
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 
 def _masked_mean(values: jax.Array, where) -> jax.Array:
@@ -33,8 +52,9 @@ def softmax_xent(logits: jax.Array, onehot: jax.Array,
 def token_nll(logits: jax.Array, labels: jax.Array, *,
               label_smoothing: float = 0.0) -> jax.Array:
     """Per-token negative log-likelihood (gather form, no one-hots) —
-    the shared numerics core of :func:`softmax_xent_int_labels` and the
-    chunked LM loss (models/gpt.py), so the two can never diverge."""
+    the post-logits numerics :func:`softmax_xent_int_labels` and
+    :func:`lm_nll_hits` (and through it every materialized-logits LM
+    loss path) are built on."""
     logz = jax.nn.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(
         logits, labels[..., None], axis=-1).squeeze(-1)
@@ -80,6 +100,307 @@ def accuracy(logits: jax.Array, labels: jax.Array,
     static-shape eval tail."""
     hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
     return _masked_mean(hit, where)
+
+
+# ---------------------------------------------------------------------------
+# LM-head cross-entropy: full / chunked / fused share ONE core
+# ---------------------------------------------------------------------------
+
+#: fused-path vocab tile when the caller leaves the knob at 0. 2048 keeps
+#: the per-block [N, block] f32 logits tile at 1/15th of the 30,522-vocab
+#: full tensor while the [N, H] @ [H, 2048] block matmul stays MXU-dense;
+#: experiments/vocab_chain_sweep.py sweeps the choice.
+DEFAULT_VOCAB_BLOCK = 2048
+
+LM_LOSS_IMPLS = ("full", "chunked", "fused")
+
+
+def lm_nll_hits(logits: jax.Array, labels: jax.Array, *,
+                accuracy: bool = True):
+    """Per-token ``(nll, hit)`` from materialized logits — the ONE
+    post-logits numerics every materialized LM loss path (full,
+    seq-chunked) runs, and the oracle the fused path's online pass is
+    parity-tested against. ``accuracy=False`` statically drops the
+    argmax (``hit`` is None): the per-step accuracy argmax costs real
+    step time at a 30k vocab (measured 3.2 ms/step on the GPT-small
+    bench config — BASELINE.md "Vocab chain")."""
+    nll = token_nll(logits, labels)
+    if not accuracy:
+        return nll, None
+    hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    return nll, hit
+
+
+def weighted_token_mean(nll: jax.Array, hit, w: jax.Array):
+    """Weighted token means -> ``(loss, accuracy)``; ``hit=None`` (the
+    argmax was skipped) publishes the -1.0 sentinel so a skipped metric
+    can never be mistaken for a real 0-accuracy reading."""
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    loss = jnp.sum(nll * w) / denom
+    if hit is None:
+        return loss, jnp.float32(-1.0)
+    return loss, jnp.sum(hit * w) / denom
+
+
+def _head_logits(h: jax.Array, table: jax.Array, bias, dtype):
+    """[..., H] @ [V, H]^T (+ bias) -> [..., V] f32 logits. The one
+    LM-head matmul definition: compute dtype on the operands, f32
+    accumulation/output (``preferred_element_type``) — identical math
+    whether the caller materializes the full vocab or a block of it."""
+    if dtype is not None:
+        h = h.astype(dtype)
+        table = table.astype(dtype)
+    logits = jnp.einsum("...th,vh->...tv", h, table,
+                        preferred_element_type=jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    return logits
+
+
+def _vocab_blocks(table: jax.Array, bias, block: int):
+    """[V, H] table (+ optional [V] bias) -> ([nb, block, H],
+    [nb, block] | None, nb) zero-padded to a whole number of blocks;
+    padded columns are masked to -inf by the scan bodies (a zero-padded
+    row would contribute exp(h·0) = 1 to the softmax sum)."""
+    v, hd = table.shape
+    nb = -(-v // block)
+    pad = nb * block - v
+    if pad:
+        table = jnp.pad(table, ((0, pad), (0, 0)))
+        bias = None if bias is None else jnp.pad(bias, (0, pad))
+    return (table.reshape(nb, block, hd),
+            None if bias is None else bias.reshape(nb, block), nb)
+
+
+def _fused_fwd_pass(h, table, bias, labels, block, dtype):
+    """One ``lax.scan`` over vocab blocks: partial logits h @ E[v0:v1]^T,
+    online logsumexp (running max + rescaled sumexp), the label's logit
+    picked in whichever block holds it, and a running argmax — so
+    token_accuracy rides the same pass instead of paying a separate
+    full-vocab argmax. Returns per-token (nll, argmax, logz); at most
+    one [N, block] logits tile is ever live."""
+    v = table.shape[0]
+    if dtype is not None:
+        h = h.astype(dtype)
+        table = table.astype(dtype)
+    blocks, biases, nb = _vocab_blocks(table, bias, block)
+    offs = jnp.arange(nb, dtype=jnp.int32) * block
+    n = h.shape[0]
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),   # running max m
+            jnp.zeros((n,), jnp.float32),            # sumexp scaled e^-m
+            jnp.zeros((n,), jnp.float32),            # label's logit
+            jnp.full((n,), -jnp.inf, jnp.float32),   # best logit
+            jnp.zeros((n,), jnp.int32))              # best (argmax) index
+
+    def body(carry, xs):
+        m, s, picked, best, best_idx = carry
+        blk, bb, off = xs
+        logits = _head_logits(h, blk, bb, None)          # [n, block] f32
+        cols = off + jnp.arange(block, dtype=jnp.int32)
+        logits = jnp.where(cols[None, :] < v, logits, -jnp.inf)
+        bm = jnp.max(logits, axis=-1)    # finite: every block has a
+        m_new = jnp.maximum(m, bm)       # real column (nb = ceil(V/B))
+        s = (s * jnp.exp(m - m_new)
+             + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=-1))
+        rel = labels - off
+        in_blk = (rel >= 0) & (rel < block)
+        pick = jnp.take_along_axis(
+            logits, jnp.clip(rel, 0, block - 1)[:, None], axis=-1)[:, 0]
+        picked = jnp.where(in_blk, pick, picked)
+        # strict > keeps the EARLIEST tied block, and the in-block argmax
+        # keeps the earliest tied column — exactly jnp.argmax's
+        # first-occurrence tie rule over the full vocab
+        better = bm > best
+        best = jnp.where(better, bm, best)
+        best_idx = jnp.where(
+            better, off + jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            best_idx)
+        return (m_new, s, picked, best, best_idx), None
+
+    (m, s, picked, _, best_idx), _ = lax.scan(body, init,
+                                              (blocks, biases, offs))
+    logz = m + jnp.log(s)
+    return logz - picked, best_idx, logz
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused_nll_argmax(block, dtype, h, table, bias, labels):
+    """Fused LM-head xent primal: per-token (nll f32, argmax int32)
+    with no [N, V] logits tensor in forward or backward (custom VJP
+    below regenerates each block's logits once)."""
+    nll, best_idx, _ = _fused_fwd_pass(h, table, bias, labels, block,
+                                       dtype)
+    return nll, best_idx
+
+
+def _fused_fwd(block, dtype, h, table, bias, labels):
+    nll, best_idx, logz = _fused_fwd_pass(h, table, bias, labels, block,
+                                          dtype)
+    return (nll, best_idx), (h, table, bias, labels, logz)
+
+
+def _fused_bwd(block, dtype, res, cts):
+    """Blockwise backward: per vocab block, regenerate the [N, block]
+    logits once, form d_logits = (softmax - onehot) · g, and accumulate
+    dh (scan carry) and the tied-embedding/bias gradient (scan stack →
+    [V, H]) — the full-vocab d_logits tensor never exists either."""
+    g, _ = cts                    # cotangent for nll; argmax ct is float0
+    h, table, bias, labels, logz = res
+    v, hd = table.shape
+    hc = h.astype(dtype) if dtype is not None else h
+    blocks, biases, nb = _vocab_blocks(
+        table.astype(dtype) if dtype is not None else table,
+        bias, block)
+    offs = jnp.arange(nb, dtype=jnp.int32) * block
+    gf = g.astype(jnp.float32)
+
+    def body(dh, xs):
+        blk, bb, off = xs
+        logits = _head_logits(hc, blk, bb, None)
+        cols = off + jnp.arange(block, dtype=jnp.int32)
+        logits = jnp.where(cols[None, :] < v, logits, -jnp.inf)
+        p = jnp.exp(logits - logz[:, None])      # exp(-inf) = 0 on pads
+        d = (p - (cols[None, :] == labels[:, None])) * gf[:, None]
+        # backward matmuls in f32: the full-logits oracle's VJP
+        # accumulates its f32 cotangent the same way
+        dh = dh + jnp.einsum("nv,vh->nh", d, blk.astype(jnp.float32),
+                             preferred_element_type=jnp.float32)
+        dtab = jnp.einsum("nv,nh->vh", d, hc.astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+        # no dead dbias reductions on a bias-less (tied) head
+        db = None if bias is None else jnp.sum(d, axis=0)
+        return dh, (dtab, db)
+
+    dh, (dtabs, dbs) = lax.scan(
+        body, jnp.zeros(hc.shape, jnp.float32), (blocks, biases, offs))
+    dtable = dtabs.reshape(nb * block, hd)[:v].astype(table.dtype)
+    dbias = (None if bias is None
+             else dbs.reshape(nb * block)[:v].astype(bias.dtype))
+    return (dh.astype(h.dtype), dtable, dbias,
+            np.zeros(labels.shape, jax.dtypes.float0))
+
+
+_fused_nll_argmax.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_linear_xent(h: jax.Array, table: jax.Array, labels: jax.Array,
+                      *, bias=None, vocab_block: int = 0, dtype=None):
+    """Fused blockwise LM-head cross-entropy: ``h [..., H]`` against the
+    (tied) embedding ``table [V, H]`` -> per-token ``(nll f32,
+    argmax int32)`` without materializing ``[..., V]`` logits in either
+    direction. ``vocab_block`` is the vocab tile (0 =
+    ``DEFAULT_VOCAB_BLOCK``); V need not divide it (the tail block is
+    padded and masked). ``dtype`` is the matmul compute dtype (bf16 on
+    TPU), accumulation stays f32 — same recipe as the full-logits
+    einsum it replaces."""
+    block = int(vocab_block) if vocab_block else DEFAULT_VOCAB_BLOCK
+    if block < 1:
+        raise ValueError(
+            f"lm_loss_vocab_block={vocab_block} invalid: must be >= 1 "
+            "(or 0 for the default)")
+    v = table.shape[0]
+    lead = h.shape[:-1]
+    n = math.prod(lead)
+    h2 = h.reshape(n, h.shape[-1])
+    lab = labels.reshape(n).astype(jnp.int32)
+    nll, idx = _fused_nll_argmax(min(block, max(v, 1)), dtype, h2, table,
+                                 bias, lab)
+    return nll.reshape(lead), idx.reshape(lead)
+
+
+def _chunked_lm_xent(h, table, labels, w, *, bias, seq_chunk, dtype,
+                     accuracy):
+    """Sequence-chunked LM-head xent: per seq chunk, compute the
+    [B, chunk, V] logits + nll/hits and DROP them (``jax.checkpoint``
+    recomputes in backward), so at most one chunk's logits are ever
+    resident. The pre-fused-era memory lever; kept as the fallback."""
+    b, s, hd = h.shape
+    if s % seq_chunk:
+        raise ValueError(
+            f"loss_chunk={seq_chunk} must divide seq_len={s} (a silent "
+            "full-logits fallback would OOM exactly the configs the "
+            "knob exists for)")
+    n = s // seq_chunk
+    hs = h.reshape(b, n, seq_chunk, hd).transpose(1, 0, 2, 3)
+    ts = labels.reshape(b, n, seq_chunk).transpose(1, 0, 2)
+    ws = w.reshape(b, n, seq_chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        hh, tt, ww = xs
+        nll, hit = lm_nll_hits(_head_logits(hh, table, bias, dtype), tt,
+                               accuracy=accuracy)
+        lsum, hsum, wsum = carry
+        hadd = jnp.sum(hit * ww) if accuracy else 0.0
+        return (lsum + jnp.sum(nll * ww), hsum + hadd,
+                wsum + jnp.sum(ww)), None
+
+    (lsum, hsum, wsum), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+               jnp.zeros((), jnp.float32)), (hs, ts, ws))
+    denom = jnp.maximum(wsum, 1.0)
+    if not accuracy:
+        return lsum / denom, jnp.float32(-1.0)
+    return lsum / denom, hsum / denom
+
+
+def lm_head_xent(h: jax.Array, table: jax.Array, labels: jax.Array,
+                 weights: jax.Array, *, bias=None, impl: str = "full",
+                 seq_chunk: int = 0, vocab_block: int = 0, dtype=None,
+                 accuracy: bool = True):
+    """THE LM-head loss: weighted-mean softmax cross-entropy + token
+    accuracy of ``h [..., T, H]`` decoded against the (tied) embedding
+    ``table [V, H]``, returned as ``(loss, accuracy)`` scalars.
+
+    ``impl`` picks the execution strategy — same numbers, different
+    memory/time shape:
+
+    - ``"full"``: materialize the [..., T, V] logits (the parity oracle
+      and kill switch).
+    - ``"chunked"``: sequence chunks of ``seq_chunk`` positions under
+      ``jax.checkpoint`` (needs 3-D ``h``; the legacy
+      ``--lm_loss_chunk`` path).
+    - ``"fused"``: blockwise over ``vocab_block`` vocab columns with a
+      custom VJP — no full logits in forward or backward, and the
+      accuracy argmax rides the same pass for free.
+
+    ``accuracy=False`` statically drops the argmax on the full/chunked
+    paths (returns the -1.0 sentinel) — the ``token_accuracy_every_n``
+    lever; the fused path's argmax is free and always on.
+    """
+    if impl not in LM_LOSS_IMPLS:
+        raise ValueError(f"lm_loss_impl must be one of {LM_LOSS_IMPLS}, "
+                         f"got {impl!r}")
+    if vocab_block and impl != "fused":
+        raise ValueError(
+            f"lm_loss_vocab_block={vocab_block} tunes the fused vocab "
+            f"scan and requires impl='fused', got {impl!r} (a silently "
+            "ignored knob is worse than an error)")
+    if seq_chunk and impl != "chunked":
+        raise ValueError(
+            f"seq_chunk={seq_chunk} is the chunked impl's lever; got "
+            f"impl={impl!r}")
+    w = weights.astype(jnp.float32)
+    if impl == "fused":
+        nll, pred = fused_linear_xent(h, table, labels, bias=bias,
+                                      vocab_block=vocab_block,
+                                      dtype=dtype)
+        hit = (pred == labels).astype(jnp.float32)
+        return weighted_token_mean(nll, hit, w)
+    if impl == "chunked":
+        if seq_chunk < 1:
+            raise ValueError(
+                "impl='chunked' needs seq_chunk >= 1 (lm_loss_chunk)")
+        if h.ndim != 3:
+            raise ValueError(
+                f"chunked LM loss chunks the sequence axis of a "
+                f"[B, S, H] hidden stream; got ndim={h.ndim}")
+        return _chunked_lm_xent(h, table, labels, w, bias=bias,
+                                seq_chunk=seq_chunk, dtype=dtype,
+                                accuracy=accuracy)
+    nll, hit = lm_nll_hits(_head_logits(h, table, bias, dtype), labels,
+                           accuracy=accuracy)
+    return weighted_token_mean(nll, hit, w)
 
 
 def topk_accuracy(logits: jax.Array, labels: jax.Array, k: int,

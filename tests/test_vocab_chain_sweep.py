@@ -1,0 +1,68 @@
+"""vocab_chain_sweep: analytic model sanity + the fresh-process CPU
+smoke grid (the acceptance path: the sweep runs end-to-end on CPU)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "experiments", "vocab_chain_sweep.py")
+sys.path.insert(0, os.path.join(ROOT, "experiments"))
+
+import vocab_chain_sweep as vcs  # noqa: E402
+
+
+def test_roofline_rows_are_consistent():
+    """The analytic model's invariants: fused/chunked pay the recompute
+    FLOPs (8nhv vs full's 6nhv); fused's peak logits residency is the
+    block tile, orders of magnitude under full's [N, V]; the chunked
+    table re-stream grows with S/chunk."""
+    b, s = 32, 512
+    full = vcs.roofline_row("full", b, s, 0)
+    chunked = vcs.roofline_row("chunked", b, s, 512)
+    fused = vcs.roofline_row("fused", b, s, 2048)
+    assert chunked["chain_TF"] == fused["chain_TF"] > full["chain_TF"]
+    assert fused["peak_logits_MiB"] < full["peak_logits_MiB"] / 5
+    assert full["peak_logits_MiB"] == pytest.approx(
+        32 * 512 * 30522 * 4 / 2**20, rel=1e-4)   # rows round to 2dp
+    # chunked at long S re-streams the table per chunk
+    long_chunked = vcs.roofline_row("chunked", 4, 4096, 512)
+    assert long_chunked["table_GB"] > chunked["table_GB"]
+    # every committed grid cell produces a valid row
+    for bb, ss in vcs.SHAPES:
+        for impl, size in [("full", 0), ("chunked", vcs.CHUNK)] + [
+                ("fused", blk) for blk in vcs.BLOCKS]:
+            row = vcs.roofline_row(impl, bb, ss, size)
+            assert row["mxu_floor_ms"] > 0 and row["hbm_floor_ms"] > 0
+
+
+def test_roofline_mode_prints_json_lines(capsys):
+    vcs.roofline()
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == len(vcs.SHAPES) * (2 + len(vcs.BLOCKS))
+    for ln in lines:
+        json.loads(ln)
+
+
+@pytest.mark.slow   # fresh-process cells: one compile per cell on CPU
+def test_smoke_grid_runs_end_to_end_on_cpu():
+    """`--smoke` (the CI path): every impl — full, chunked, fused incl.
+    a vocab-not-divisible block — runs a real train step in a fresh
+    process and emits the JSON cell contract with a finite loss."""
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--smoke"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 4, (out.stdout, out.stderr)
+    cells = [json.loads(ln) for ln in lines]
+    impls = [(c["impl"], c["size"]) for c in cells]
+    assert impls == [("full", None), ("chunked", 32),
+                     ("fused", 128), ("fused", 200)]
+    for c in cells:
+        assert "error" not in c, c
+        assert c["loss_finite"] and c["step_ms"] > 0, c
