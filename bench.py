@@ -44,7 +44,10 @@ Every training row also publishes ``{key}_peak_mib`` (XLA memory-
 analysis peak for the compiled step, when the backend reports it) so
 memory levers — the fused LM loss killing the [B,S,V] logits
 residency, remat, storage dtypes — are regression-visible columns, not
-folklore.
+folklore — and ``{key}_anomaly_count`` (the on-device non-finite-step
+counter carried in TrainState), so a "fast but silently skipping
+steps" regression is a visible nonzero column, not a quiet throughput
+win.
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
@@ -250,10 +253,12 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
          cfg_over: dict | None = None,
          steps_per_call: int = 1, prng_impl: str | None = None):
     """Time `steps` sync steps; returns (examples/sec/chip, step_ms, mfu,
-    mfu_basis, peak_mib, suspect) — ``peak_mib`` is the compiled step's
-    XLA memory-analysis peak (None when unreported) and ``suspect``
-    marks a measurement robust_time could not de-corrupt (callers
-    surface it, never publish it as real).
+    mfu_basis, peak_mib, suspect, anomaly_count) — ``peak_mib`` is the
+    compiled step's XLA memory-analysis peak (None when unreported),
+    ``suspect`` marks a measurement robust_time could not de-corrupt
+    (callers surface it, never publish it as real), and
+    ``anomaly_count`` is the run's cumulative non-finite-step count from
+    the on-device detector.
 
     ``steps_per_call > 1`` uses the device-side multi-step loop
     (iterations_per_loop) — essential for latency-bound microbenchmarks
@@ -318,7 +323,12 @@ def _run(model_name: str, *, batch: int, steps: int, warmup: int,
     step_s = dt / steps
     eps_chip = batch / step_s / n_dev
     mfu = (flops / step_s / (peak * n_dev)) if (flops and peak) else None
-    return eps_chip, step_s * 1e3, mfu, mfu_basis, peak_mib, suspect
+    # cumulative non-finite-step count from the on-device anomaly
+    # detector: a "fast but silently skipping steps" regression shows up
+    # as a nonzero column in the gate, not as a quiet throughput win
+    anomalies = int(jax.device_get(state.anomaly_count))
+    return (eps_chip, step_s * 1e3, mfu, mfu_basis, peak_mib, suspect,
+            anomalies)
 
 
 def _mnist_batch(model, batch, i):
@@ -655,7 +665,7 @@ def main() -> None:
             if row["suspect"]:
                 extra[f"{key}_suspect"] = True
             continue
-        eps, ms, mfu, mfu_basis, peak_mib, suspect = _run(
+        eps, ms, mfu, mfu_basis, peak_mib, suspect, anomalies = _run(
             w["model"], batch=w["batch"], steps=w["steps"],
             warmup=w["warmup"], opt=w["opt"],
             make_batch=w["make_batch"],
@@ -664,6 +674,9 @@ def main() -> None:
             prng_impl=w.get("prng_impl"))
         extra[f"{key}_eps_chip"] = round(eps, w.get("eps_digits", 1))
         extra[f"{key}_step_ms"] = round(ms, w.get("ms_digits", 2))
+        # always published, even at 0: the gate diffs rows, and a column
+        # that only appears when nonzero cannot be watched for regressions
+        extra[f"{key}_anomaly_count"] = anomalies
         if mfu:
             extra[f"{key}_mfu"] = round(mfu, 4)
             extra[f"{key}_mfu_basis"] = mfu_basis

@@ -43,18 +43,71 @@ import re
 import tempfile
 import threading
 import time
+import zlib
 from typing import Any
 
 import jax
 import ml_dtypes
 import numpy as np
 
+from ..runtime import faults
+from ..utils.logging import get_logger
 from ..utils.pytree import is_prng_key as _is_key, path_str as _path_str
+
+log = get_logger("ckpt")
 
 PyTree = Any
 
 STATE_FILE = "checkpoint"          # parity with TF's 'checkpoint' proto file
 PREFIX = "ckpt"
+
+#: reserved npz key: JSON {array key -> crc32 of its raw bytes}, recorded
+#: at save and verified on restore (the Orbax-style checksummed-checkpoint
+#: pattern) — catches torn/zero-filled/bit-rotted files that still parse
+_CRC_KEY = "__crc32__"
+
+#: state leaves added AFTER checkpoints already existed in the wild:
+#: when absent from a checkpoint they default to zeros instead of
+#: failing the whole restore. ONE list consulted by both restore paths
+#: (single-file _unflatten and _restore_sharded) so the formats can
+#: never disagree on back-compat.
+DEFAULTABLE_LEAVES = ("anomaly_count",)   # round-8 anomaly counter
+
+
+class CorruptCheckpointError(FileNotFoundError):
+    """A checkpoint that exists but cannot be trusted: unreadable zip,
+    failed CRC32, or missing shard pieces. Subclasses FileNotFoundError
+    so existing no-usable-checkpoint handling (CLI eval paths) keeps
+    working; the message names the file, the step, and — when the caller
+    fell back — the checkpoint restored instead."""
+
+
+def _crc32_of(arr: np.ndarray) -> int:
+    a = np.ascontiguousarray(arr)
+    # numpy arrays expose the buffer protocol: no bytes copy
+    return zlib.crc32(a.reshape(-1).view(np.uint8)) if a.size else 0
+
+
+def _with_crcs(arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    crcs = {k: _crc32_of(np.asarray(v)) for k, v in arrays.items()}
+    out = dict(arrays)
+    out[_CRC_KEY] = np.frombuffer(json.dumps(crcs).encode(), dtype=np.uint8)
+    return out
+
+
+def _load_npz_verified(path: str, step: int | None = None
+                       ) -> dict[str, np.ndarray]:
+    """Read every array of an npz with verification (one shared
+    implementation: :class:`_VerifiedNpz`). Any failure — unreadable
+    zip, bad member CRC, missing or mismatched arrays — becomes one
+    clear CorruptCheckpointError instead of a bare zipfile/numpy
+    traceback. Checkpoints predating the CRC record load without
+    content verification (the zip layer still catches torn members)."""
+    z = _VerifiedNpz(path, step)
+    try:
+        return {k: z[k] for k in z.files}
+    finally:
+        z.close()
 
 
 def _to_host(leaf) -> np.ndarray:
@@ -182,6 +235,57 @@ def _flatten_local(state: PyTree) -> tuple[dict[str, np.ndarray], dict]:
     return pieces, meta
 
 
+class _VerifiedNpz:
+    """Lazy npz reader that verifies each member's recorded CRC32 as it
+    is read — the sharded restore path keeps its selective-read property
+    (a process only reads the pieces its sharding needs) while every
+    byte actually consumed is still integrity-checked. Open and read
+    errors, and CRC mismatches, all surface as CorruptCheckpointError."""
+
+    def __init__(self, path: str, step: int | None = None):
+        self.path = path
+        self.step = step
+        at = f"step {step} " if step is not None else ""
+        try:
+            self._z = np.load(path)
+            self._crcs = (json.loads(bytes(self._z[_CRC_KEY]).decode())
+                          if _CRC_KEY in self._z.files else None)
+        except Exception as e:
+            raise CorruptCheckpointError(
+                f"checkpoint {at}file {path!r} is unreadable "
+                f"({type(e).__name__}: {e})") from e
+        if self._crcs is not None:
+            present = set(self.files)
+            if set(self._crcs) != present:
+                raise CorruptCheckpointError(
+                    f"checkpoint {at}file {path!r} array set does not "
+                    f"match its CRC record (missing "
+                    f"{sorted(set(self._crcs) - present)}, unrecorded "
+                    f"{sorted(present - set(self._crcs))})")
+
+    @property
+    def files(self) -> list[str]:
+        return [k for k in self._z.files if k != _CRC_KEY]
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        at = f"step {self.step} " if self.step is not None else ""
+        try:
+            v = self._z[key]
+        except Exception as e:
+            raise CorruptCheckpointError(
+                f"checkpoint {at}file {self.path!r} member {key!r} is "
+                f"unreadable ({type(e).__name__}: {e})") from e
+        if self._crcs is not None and (
+                key not in self._crcs or _crc32_of(v) != self._crcs[key]):
+            raise CorruptCheckpointError(
+                f"checkpoint {at}file {self.path!r} fails CRC32 "
+                f"verification at array {key!r} — corrupt on disk")
+        return v
+
+    def close(self) -> None:
+        self._z.close()
+
+
 def _merge_metas(loads: dict[str, "np.lib.npyio.NpzFile"]) -> dict[str, dict]:
     """Merge every open shard file's embedded piece index into one leaf
     map; each piece entry gains a ``file`` field naming its shard file."""
@@ -236,6 +340,11 @@ def _unflatten(template: PyTree, arrays: dict[str, np.ndarray]) -> PyTree:
             leaf = arrays["__bf16__/" + key].view(ml_dtypes.bfloat16)
         elif key in arrays:
             leaf = arrays[key]
+        elif key in DEFAULTABLE_LEAVES and hasattr(tleaf, "dtype"):
+            # checkpoints written before this leaf existed: default it
+            # instead of refusing the whole restore
+            leaf = np.zeros(tuple(getattr(tleaf, "shape", ())),
+                            np.dtype(tleaf.dtype))
         else:
             raise KeyError(f"checkpoint missing leaf {key!r}")
         if hasattr(tleaf, "shape") and tuple(leaf.shape) != tuple(tleaf.shape):
@@ -360,9 +469,15 @@ class CheckpointManager:
             pending.result()
 
     def close(self) -> None:
-        self.wait()
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
+        """Drain the writer thread and release it. A pending async_save
+        error SURFACES here (wait() re-raises it) — but the executor is
+        still shut down first-class in that case, so a failed final save
+        cannot also leak the writer thread."""
+        try:
+            self.wait()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
 
     def save(self, state: PyTree, step: int | None = None) -> str | None:
         """Gather to host and write ``ckpt-<step>.npz``; rotate the ring.
@@ -386,24 +501,54 @@ class CheckpointManager:
             # depth-1 queue: drain the previous write (surfacing its
             # errors) and submit the new one under ONE lock hold, so two
             # concurrent save() calls cannot both pass the drain and
-            # overwrite each other's Future
+            # overwrite each other's Future. The drained future is
+            # CONSUMED before .result() so its error surfaces exactly
+            # once — not again from every later wait()/close()
             with self._pending_lock:
-                if self._pending is not None:
-                    self._pending.result()
+                pending, self._pending = self._pending, None
+                if pending is not None:
+                    pending.result()
                 self._pending = self._executor.submit(
                     self._write, arrays, step)
             return self.checkpoint_path(step)
         return self._write(arrays, step)
 
     def _atomic_npz(self, arrays: dict[str, np.ndarray], path: str) -> None:
+        rule = faults.inject("ckpt.write", detail=f"writing {path!r}")
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
-        os.close(fd)
-        np.savez(tmp, **arrays)
-        # np.savez appends .npz to names lacking it
-        tmp_npz = tmp if tmp.endswith(".npz") else tmp + ".npz"
-        os.replace(tmp_npz, path)
-        if tmp != tmp_npz and os.path.exists(tmp):
-            os.remove(tmp)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                # per-array CRC32s ride inside the file; restore verifies
+                np.savez(f, **_with_crcs(arrays))
+                # fsync BEFORE rename: without it a crash can commit the
+                # rename while the data blocks never hit disk — exactly
+                # the truncated-checkpoint failure the verified-restore
+                # fallback exists for, but durability is cheaper than
+                # recovery
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        dirfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)        # persist the rename itself
+        finally:
+            os.close(dirfd)
+        if rule is not None and rule.corrupt:
+            # torn-write simulation: damage the LANDED file — the failure
+            # mode the CRC verification + valid-step fallback must absorb
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                if rule.corrupt == "truncate":
+                    f.truncate(max(1, int(size * 0.6)))
+                else:                          # zero: overwrite a span
+                    f.seek(size // 3)
+                    f.write(b"\0" * max(1, size // 3))
+            log.warning("fault injected: %s landed file %r damaged",
+                        rule.describe(), path)
 
     def _remove_victim(self, victim: str) -> None:
         """Delete a rotated-out checkpoint — all of it, for sharded ones."""
@@ -421,6 +566,7 @@ class CheckpointManager:
 
     def _commit(self, base: str) -> None:
         """Record anchor ``base`` in the state file + rotate the ring."""
+        faults.inject("ckpt.commit", detail=f"committing {base!r}")
         st = self._state()
         now = time.time()
         # a step may only live in ONE list (plus possibly the 'best'
@@ -519,8 +665,11 @@ class CheckpointManager:
 
         if self._executor is not None:      # single-process only (ctor)
             with self._pending_lock:
-                if self._pending is not None:
-                    self._pending.result()
+                # consume-before-drain, same as save(): a failed write
+                # surfaces exactly once
+                pending, self._pending = self._pending, None
+                if pending is not None:
+                    pending.result()
                 self._pending = self._executor.submit(write_and_commit)
             return shard_path
         return write_and_commit()
@@ -583,22 +732,152 @@ class CheckpointManager:
         best = self._state().get("best")
         return int(best["step"]) if best else None
 
-    def restore(self, template: PyTree, step: int | None = None) -> PyTree:
-        """Load ``step`` (default: latest) into the template's structure &
-        shardings. Raises FileNotFoundError when nothing exists. The
+    # -- integrity probing ------------------------------------------------
+    def verify_step(self, step: int) -> None:
+        """Read EVERY byte of ``step``'s checkpoint and check the recorded
+        CRC32s. Raises CorruptCheckpointError (or FileNotFoundError when
+        nothing exists at that step); returns None when the checkpoint is
+        whole. This is the probe latest_valid_step / _agreed_latest_step
+        use to pick a restore target that will actually restore."""
+        path = self.checkpoint_path(step)
+        if os.path.exists(path):
+            # stream one member at a time (same as the sharded probe
+            # below): the probe must not spike host RAM by the full
+            # checkpoint size just to discard the arrays
+            z = _VerifiedNpz(path, step)
+            try:
+                for k in z.files:
+                    z[k]                   # read + CRC-check each
+            finally:
+                z.close()
+            return
+        anchor = self.shard_anchor_path(step)
+        if not os.path.exists(anchor):
+            raise FileNotFoundError(
+                f"no checkpoint at step {step} under {self.directory!r}")
+        try:
+            with open(anchor) as f:
+                files = json.load(f)["files"]
+        except Exception as e:
+            raise CorruptCheckpointError(
+                f"checkpoint step {step} anchor {anchor!r} is unreadable "
+                f"({type(e).__name__}: {e})") from e
+        for b in files:
+            p = os.path.join(self.directory, b)
+            if not os.path.exists(p):
+                raise CorruptCheckpointError(
+                    f"checkpoint step {step} is missing shard file {b!r}")
+            z = _VerifiedNpz(p, step)
+            try:
+                for k in z.files:
+                    z[k]                       # read + CRC-check each
+            finally:
+                z.close()
+
+    def latest_valid_step(self, max_step: int | None = None) -> int | None:
+        """Newest step whose checkpoint passes verification, probing
+        newest→oldest and logging each corrupt candidate it skips —
+        the restore-target selector that makes a truncated latest file a
+        logged fallback instead of a crashed run. ``max_step`` bounds the
+        search (the rollback policy restores at or before the last
+        KNOWN-CLEAN step, not merely the newest file)."""
+        steps = self.all_steps()
+        if max_step is not None:
+            steps = [s for s in steps if s <= max_step]
+        for step in reversed(steps):
+            try:
+                self.verify_step(step)
+                return step
+            except FileNotFoundError as e:
+                log.error("checkpoint step %d failed verification (%s) — "
+                          "falling back to the previous checkpoint", step, e)
+        return None
+
+    def discard_steps_above(self, step: int) -> list[int]:
+        """Delete every checkpoint NEWER than ``step`` (writer-only;
+        returns the discarded steps). The rollback policy's truncation:
+        checkpoints saved after the last clean step embed the rejected
+        (skipped-update) trajectory — leaving them on disk would make a
+        restart resume the exact trajectory the rollback discarded. The
+        'best' pointer is cleared too when it names a discarded step."""
+        if not self.is_writer:
+            return []
+        with self._lock:
+            st = self._state()
+            discarded: list[int] = []
+
+            def keep(base: str) -> bool:
+                m = re.search(rf"{PREFIX}-(\d+)\.(npz|shards\.json)$", base)
+                if m and int(m.group(1)) > step:
+                    discarded.append(int(m.group(1)))
+                    self._remove_victim(base)
+                    return False
+                return True
+
+            st["all_model_checkpoint_paths"] = [
+                b for b in st["all_model_checkpoint_paths"] if keep(b)]
+            st["kept_forever"] = [b for b in st.get("kept_forever", [])
+                                  if keep(b)]
+            best = st.get("best")
+            if best and int(best.get("step", -1)) > step:
+                keep(best["path"])
+                st["best"] = None
+            if st["latest"] and not self._anchor_exists_base(st["latest"]):
+                remaining = st["all_model_checkpoint_paths"] \
+                    + st.get("kept_forever", [])
+                st["latest"] = remaining[-1] if remaining else None
+            self._write_state(st)
+        return sorted(set(discarded))
+
+    def _anchor_exists_base(self, base: str) -> bool:
+        return os.path.exists(os.path.join(self.directory, base))
+
+    def restore(self, template: PyTree, step: int | None = None,
+                max_step: int | None = None) -> PyTree:
+        """Load ``step`` (default: newest VALID) into the template's
+        structure & shardings. Raises FileNotFoundError when nothing
+        exists and CorruptCheckpointError when the requested step exists
+        but cannot be trusted. With ``step=None``, a corrupt newest
+        checkpoint is logged and the next-older valid one restored
+        instead of crashing; ``max_step`` bounds that walk (the rollback
+        policy's clean-step cap — verification happens WHILE reading, so
+        the chosen checkpoint is read once, not probe+restore). The
         on-disk format (single-file vs sharded) is auto-detected, so a
         run may switch ``sharded`` modes across restarts."""
         self.wait()                # an in-flight async write may be `step`
         if step is None:
-            step = self.latest_step()
-            if step is None:
+            steps = self.all_steps()
+            if max_step is not None:
+                steps = [s for s in steps if s <= max_step]
+            if not steps:
                 raise FileNotFoundError(
-                    f"no checkpoint under {self.directory!r}")
+                    f"no checkpoint under {self.directory!r}"
+                    + (f" at or before step {max_step}"
+                       if max_step is not None else ""))
+            last_err: Exception | None = None
+            for s in reversed(steps):
+                try:
+                    out = self._restore_step(template, s)
+                    if last_err is not None:
+                        log.error("restored fallback checkpoint step %d "
+                                  "(newer checkpoint was corrupt: %s)",
+                                  s, last_err)
+                    return out
+                except CorruptCheckpointError as e:
+                    log.error("checkpoint step %d corrupt (%s) — falling "
+                              "back to the previous checkpoint", s, e)
+                    last_err = e
+            raise CorruptCheckpointError(
+                f"every checkpoint under {self.directory!r} (steps "
+                f"{steps}) failed verification; last error: {last_err}; "
+                "no fallback remains")
+        return self._restore_step(template, step)
+
+    def _restore_step(self, template: PyTree, step: int) -> PyTree:
+        faults.inject("ckpt.read", detail=f"restoring step {step}")
         path = self.checkpoint_path(step)
         if os.path.exists(path):
-            with np.load(path) as z:
-                arrays = {k: z[k] for k in z.files}
-            return _unflatten(template, arrays)
+            return _unflatten(template, _load_npz_verified(path, step))
         if os.path.exists(self.shard_anchor_path(step)):
             return self._restore_sharded(template, step)
         raise FileNotFoundError(path)
@@ -609,11 +888,13 @@ class CheckpointManager:
         paths = [os.path.join(self.directory, b) for b in anchor["files"]]
         missing = [p for p in paths if not os.path.exists(p)]
         if missing:
-            raise FileNotFoundError(
+            raise CorruptCheckpointError(
                 f"sharded checkpoint step {step} is missing shard files "
                 f"{[os.path.basename(m) for m in missing]} — all shards "
                 "must live on a filesystem every host can read")
-        loads = {p: np.load(p) for p in paths}
+        # lazy verified reads: only pieces this process's sharding needs
+        # are read, and each is CRC-checked as it is consumed
+        loads = {p: _VerifiedNpz(p, step) for p in paths}
         metas = _merge_metas(loads)
         try:
             paths_and_leaves, treedef = \
@@ -622,6 +903,12 @@ class CheckpointManager:
             for path_, tleaf in paths_and_leaves:
                 key = _path_str(path_)
                 entry = metas.get(key)
+                if entry is None and key in DEFAULTABLE_LEAVES \
+                        and hasattr(tleaf, "dtype"):
+                    # checkpoints predating this leaf: default it
+                    leaves.append(jax.numpy.zeros(
+                        tuple(getattr(tleaf, "shape", ())), tleaf.dtype))
+                    continue
                 if entry is None:
                     raise KeyError(f"sharded checkpoint missing leaf {key!r}")
                 if entry["kind"] == "prngkey":
@@ -698,7 +985,8 @@ def latest_checkpoint(directory: str) -> str | None:
     return single if os.path.exists(single) else mgr.shard_anchor_path(step)
 
 
-def _agreed_latest_step(manager: CheckpointManager) -> int | None:
+def _agreed_latest_step(manager: CheckpointManager,
+                        max_step: int | None = None) -> int | None:
     """Latest step agreed across ALL processes.
 
     The restore-or-init decision must be identical everywhere: if process 0
@@ -711,9 +999,16 @@ def _agreed_latest_step(manager: CheckpointManager) -> int | None:
     (mirroring the reference, where workers restored through the chief's
     session rather than their own disk — session_manager.py:320-335).
     """
-    local = manager.latest_step()
+    # integrity-probed: the chief picks the newest checkpoint that
+    # actually VERIFIES (CRC32s intact, every shard present), so a
+    # truncated latest file on a restart becomes a broadcast fallback to
+    # the previous valid step instead of a crash on some processes
     if jax.process_count() == 1:
-        return local
+        return manager.latest_valid_step(max_step)
+    # only the chief's (authoritative, broadcast) view pays the
+    # verification read; other processes' argument is ignored
+    local = (manager.latest_valid_step(max_step)
+             if jax.process_index() == 0 else None)
     from jax.experimental import multihost_utils
     chief = int(multihost_utils.broadcast_one_to_all(
         np.int64(-1 if local is None else local)))
@@ -757,6 +1052,19 @@ def restore_or_init(manager: CheckpointManager | None, init_fn,
 
     Returns ``(state, restored: bool)``.
     """
+    if manager is not None and jax.process_count() == 1:
+        # single-process: the restore-or-init decision only needs a cheap
+        # existence probe; restore(step=None) verifies WHILE reading and
+        # falls back past corrupt files itself — one read of the chosen
+        # checkpoint instead of a verify pass plus a restore pass. (All
+        # candidates corrupt still raises: silently re-initializing from
+        # scratch over a damaged directory would be worse than an error.)
+        if manager.latest_step() is not None:
+            template = init_fn(*args, **kwargs)
+            return manager.restore(template, None), True
+        return init_fn(*args, **kwargs), False
+    # multi-host: the chief's verification read picks the step every
+    # process then restores — the extra read is the price of agreement
     step = _agreed_latest_step(manager) if manager is not None else None
     if step is not None:
         template = init_fn(*args, **kwargs)

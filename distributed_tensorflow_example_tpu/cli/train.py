@@ -21,7 +21,7 @@ import sys
 from ..cluster import ClusterSpec, WORKER_JOB
 from ..config import (CheckpointConfig, DataConfig, MeshShape,
                       ObservabilityConfig, OptimizerConfig, SyncConfig,
-                      TrainConfig, add_legacy_flags,
+                      TrainConfig, add_legacy_flags, anomaly_settings,
                       flash_attention_kwargs, lm_loss_settings,
                       parse_hosts)
 from ..utils.logging import get_logger
@@ -349,6 +349,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "--export_dir, export) the checkpoint the "
                         "keep_best tracker recorded instead of latest")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--on_anomaly", default="halt",
+                   choices=["halt", "skip", "rollback"],
+                   help="policy for steps whose loss or global grad-norm "
+                        "is non-finite (on-device detection, no per-step "
+                        "host sync; every policy keeps the bad update out "
+                        "of the training state): halt = stop with a "
+                        "summary; skip = identity update, keep training; "
+                        "rollback = restore the last VERIFIED checkpoint "
+                        "and replay the data stream (needs --ckpt_dir + "
+                        "--save_steps)")
+    p.add_argument("--max_anomalies", type=int, default=10,
+                   help="anomaly budget for skip/rollback: halt with a "
+                        "summary once more anomalous steps than this are "
+                        "observed (0 = halt on the first)")
+    p.add_argument("--fault_spec", default="",
+                   help="deterministic fault injection for chaos testing "
+                        "(inert when empty): ';'-separated rules like "
+                        "'ckpt.write:step=2:raise=OSError', "
+                        "'loader.next:p=0.01', 'step.nan:step=7', "
+                        "'ckpt.write:step=3:corrupt=truncate' — see "
+                        "runtime/faults.py for the grammar")
     p.add_argument("--check_nans", action="store_true",
                    help="stop on non-finite loss (NanTensorHook parity; "
                         "per-step host sync)")
@@ -410,6 +431,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         early_stop_mode=args.early_stop_mode,
         steps_per_loop=args.steps_per_loop,
         max_inflight_steps=args.max_inflight_steps,
+        on_anomaly=args.on_anomaly,
+        max_anomalies=args.max_anomalies,
+        fault_spec=args.fault_spec,
         seed=args.seed,
         dtype=args.dtype,
         param_dtype=args.param_dtype,
@@ -697,6 +721,14 @@ def main(argv: list[str] | None = None) -> int:
         # ... and on LM-loss lever misuse: conflicting impl/chunk/block
         # combinations that a model deep in the run would reject anyway
         lm_loss_settings(cfg)
+        # ... and on self-healing misconfiguration: a rollback policy
+        # with nothing to roll back to, or a fault spec the injection
+        # grammar cannot honor (a silently ignored fault rule would fake
+        # chaos coverage for a whole run)
+        anomaly_settings(cfg)
+        if cfg.fault_spec:
+            from ..runtime import faults as faults_mod
+            faults_mod.parse_spec(cfg.fault_spec, seed=cfg.seed)
     except ValueError as e:
         raise SystemExit(str(e))
     if args.export_generator and not args.model.startswith("gpt"):

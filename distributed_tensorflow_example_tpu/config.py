@@ -269,6 +269,27 @@ class TrainConfig:
                                      # misbehave under deep dispatch
                                      # queues, e.g. the round-4 tunnel
                                      # INVALID_ARGUMENT — BASELINE.md)
+    on_anomaly: str = "halt"         # policy when a step's loss or global
+                                     # grad-norm is non-finite (on-device
+                                     # detection, observed at the log
+                                     # cadence — no per-step host sync):
+                                     # halt = stop the run with a summary;
+                                     # skip = identity update, keep going;
+                                     # rollback = restore the last
+                                     # VERIFIED checkpoint and replay
+                                     # (needs checkpoint.directory +
+                                     # save_steps). Every policy keeps
+                                     # non-finite updates out of the state
+    max_anomalies: int = 10          # anomaly budget for skip/rollback:
+                                     # more anomalous steps than this
+                                     # halts the run with a summary (0 =
+                                     # halt on the first one)
+    fault_spec: str = ""             # deterministic fault injection
+                                     # (runtime/faults.py grammar, e.g.
+                                     # 'ckpt.write:step=2:raise=OSError;
+                                     # loader.next:p=0.01'); empty =
+                                     # inert — production paths pay zero
+                                     # cost
     seed: int = 0
     dtype: str = "float32"           # compute dtype: float32 | bfloat16
     param_dtype: str = "float32"
@@ -336,6 +357,45 @@ def flash_attention_kwargs(cfg: TrainConfig) -> dict:
                 f"multiple of {mult} (Mosaic tile constraint) or 0 for "
                 f"the kernel default")
     return set_levers
+
+
+#: --on_anomaly values anomaly_settings accepts
+ANOMALY_POLICIES = ("halt", "skip", "rollback")
+
+
+def anomaly_settings(cfg: TrainConfig) -> dict:
+    """Validated self-healing settings from the ``on_anomaly`` /
+    ``max_anomalies`` / ``fault_spec`` knobs — config validation, before
+    any trace. Raises ValueError on a policy no path could honor:
+    ``rollback`` without the checkpoint cadence it restores from, or a
+    negative budget. The fault_spec grammar itself is validated by
+    ``runtime.faults.parse_spec`` (jax-free here so config stays
+    importable without a backend)."""
+    if cfg.on_anomaly not in ANOMALY_POLICIES:
+        raise ValueError(f"on_anomaly must be one of {ANOMALY_POLICIES}, "
+                         f"got {cfg.on_anomaly!r}")
+    if cfg.max_anomalies < 0:
+        raise ValueError(
+            f"max_anomalies={cfg.max_anomalies} must be >= 0 (the budget "
+            "of anomalous steps tolerated before halting)")
+    if cfg.on_anomaly == "rollback":
+        if not cfg.checkpoint.directory:
+            raise ValueError(
+                "on_anomaly='rollback' restores the last verified "
+                "checkpoint and needs checkpoint.directory (--ckpt_dir)")
+        if not (cfg.checkpoint.save_steps or cfg.checkpoint.save_secs):
+            raise ValueError(
+                "on_anomaly='rollback' needs a checkpoint cadence "
+                "(--save_steps or --save_secs): with no checkpoints there "
+                "is nothing to roll back to")
+    if cfg.obs.check_nans and cfg.on_anomaly != "halt":
+        raise ValueError(
+            "check_nans (per-step NanHook) pairs with on_anomaly='halt' "
+            "only: under skip/rollback an anomalous step's metrics "
+            "publish the -1.0 skipped sentinel, so the hook could never "
+            "fire (a silently ignored knob is worse than an error)")
+    return {"policy": cfg.on_anomaly, "budget": cfg.max_anomalies,
+            "fault_spec": cfg.fault_spec}
 
 
 #: lm_loss_impl values lm_loss_settings accepts (mirrors

@@ -107,17 +107,31 @@ class PrefetchIterator:
         self._it = it
         self._done = object()
         self._err: BaseException | None = None
+        self._closed = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Blocking put that gives up when the consumer closed us —
+        an abandoned iterator must not strand its producer thread on a
+        full queue forever (rollback rebuilds the loader mid-run)."""
+        while not self._closed:
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _run(self):
         try:
             for item in self._it:
-                self._q.put(item)
+                if not self._put(item):
+                    return               # closed: stop producing
         except BaseException as e:   # propagate like Coordinator did
             self._err = e
         finally:
-            self._q.put(self._done)
+            self._put(self._done)
 
     def __iter__(self):
         return self
@@ -129,6 +143,16 @@ class PrefetchIterator:
                 raise self._err
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        """Release the producer thread (idempotent). Pending batches are
+        discarded; the thread exits at its next queue interaction."""
+        self._closed = True
+        try:
+            while True:
+                self._q.get_nowait()     # unblock a producer mid-put
+        except queue.Empty:
+            pass
 
 
 def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
@@ -161,9 +185,17 @@ def make_loader(arrays: Batch, global_batch: int, *, prefetch: int = 0,
             kw.pop("transform", None)        # None here (guard above)
             nat = native_mod.NativeLoader(arrays, global_batch, **kw)
             it = _fast_forward(nat, iter(nat), start_step)
-            return it
+            from ..runtime import faults
+            return faults.guard_iterator(it)   # same seam as the Python path
     loader = ShardedLoader(arrays, global_batch, **kw)
     it = _fast_forward(loader, iter(loader), start_step)
+    # fault-injection seam (runtime/faults.py 'loader.next'): a bare
+    # identity when no registry is installed — the production path stays
+    # an unwrapped generator. Injected transient IO errors are absorbed
+    # by the guard's bounded retry + exponential backoff, mirroring the
+    # policy real IO gets in the streaming decode path.
+    from ..runtime import faults
+    it = faults.guard_iterator(it)
     return PrefetchIterator(it, prefetch) if prefetch > 0 else it
 
 

@@ -30,12 +30,68 @@ interface.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator
+from typing import Callable, Iterator
 
 import numpy as np
 
+from ..runtime import faults
+from ..utils.logging import get_logger
 from .imagenet import augment_image, decode_image, index_image_folder
 from .loader import Batch, PrefetchIterator
+
+log = get_logger("streaming")
+
+#: default cap on samples skipped per epoch by the bad-image policy: a
+#: handful of truncated JPEGs in a web-scale corpus is routine; hundreds
+#: means the dataset (or the filesystem) is broken and the run must say so
+MAX_SKIPPED_PER_EPOCH = 64
+
+
+def _decode_resilient(pool: ThreadPoolExecutor, indices: np.ndarray,
+                      one: Callable[[int], tuple[np.ndarray, int]],
+                      *, skip_state: dict, what: str) -> Batch:
+    """Decode a batch on the thread pool with the self-healing IO policy:
+    each sample gets bounded retry + exponential backoff (transient IO),
+    and a sample that still fails (truncated/bad image) is SKIPPED — its
+    slot is refilled with another sample from the same batch (keeps the
+    batch shape static for jit) — with a logged count capped per epoch
+    via ``skip_state`` ({'epoch': int, 'count': int, 'total': int,
+    'cap': int}). A batch with no decodable sample at all, or a blown
+    cap, still raises: self-healing must not quietly train on garbage.
+    """
+    def attempt(i):
+        try:
+            return faults.retry_io(lambda: one(int(i)),
+                                   what=f"{what} sample {int(i)}")
+        except Exception as e:         # undecodable after retries: skip
+            return e
+
+    results = list(pool.map(attempt, indices))
+    bad = [k for k, r in enumerate(results) if isinstance(r, Exception)]
+    if bad:
+        good = [k for k, r in enumerate(results)
+                if not isinstance(r, Exception)]
+        if not good:
+            raise RuntimeError(
+                f"{what}: every sample in the batch failed to decode "
+                f"(first error: {results[bad[0]]}) — refusing to "
+                "fabricate a batch")
+        skip_state["count"] += len(bad)
+        skip_state["total"] += len(bad)
+        if skip_state["count"] > skip_state["cap"]:
+            raise RuntimeError(
+                f"{what}: {skip_state['count']} samples skipped this "
+                f"epoch exceeds the cap {skip_state['cap']} — the "
+                "dataset or filesystem is broken, not merely flaky")
+        log.warning(
+            "%s: skipped %d undecodable sample(s) in one batch, refilled "
+            "from batch neighbors (%d skipped this epoch, %d this run): %s",
+            what, len(bad), skip_state["count"], skip_state["total"],
+            "; ".join(str(results[k])[:120] for k in bad[:3]))
+        for n, k in enumerate(bad):
+            results[k] = results[good[n % len(good)]]
+    return {"x": np.stack([x for x, _ in results]),
+            "y": np.asarray([y for _, y in results], np.int32)}
 
 
 class StreamingImageFolder:
@@ -54,13 +110,17 @@ class StreamingImageFolder:
                  shuffle: bool = True, seed: int = 0,
                  decode_threads: int = 8,
                  augment: bool = False,
-                 fast_decode: bool = False):
+                 fast_decode: bool = False,
+                 max_skipped_per_epoch: int = MAX_SKIPPED_PER_EPOCH):
         if global_batch % num_processes:
             raise ValueError(
                 f"global_batch {global_batch} not divisible by "
                 f"{num_processes} processes")
         self.paths, self.labels = index_image_folder(
             data_dir, split, max_per_class=max_per_class)
+        # bad-image skip policy bookkeeping (_decode_resilient contract)
+        self._skip = {"epoch": 0, "count": 0, "total": 0,
+                      "cap": max_skipped_per_epoch}
         self.n = len(self.paths)
         if self.n < global_batch:
             # fail fast: steps_per_epoch=0 would make __iter__ a silent
@@ -91,14 +151,19 @@ class StreamingImageFolder:
             # bit-exactly on resume
             def one(i):
                 rng = np.random.default_rng([self.seed, epoch, int(i)])
-                return augment_image(self.paths[i], self.image_size, rng,
-                                     fast=self.fast_decode)
+                return (augment_image(self.paths[i], self.image_size, rng,
+                                      fast=self.fast_decode),
+                        int(self.labels[i]))
         else:
             def one(i):
-                return decode_image(self.paths[i], self.image_size,
-                                    fast=self.fast_decode)
-        xs = list(self._pool.map(one, indices))
-        return {"x": np.stack(xs), "y": self.labels[indices]}
+                return (decode_image(self.paths[i], self.image_size,
+                                     fast=self.fast_decode),
+                        int(self.labels[i]))
+        if self._skip["epoch"] != epoch:     # per-epoch skip-cap window
+            self._skip.update(epoch=epoch, count=0)
+        return _decode_resilient(self._pool, indices, one,
+                                 skip_state=self._skip,
+                                 what=f"image folder epoch {epoch}")
 
     def epoch_batches(self, epoch: int | None = None,
                       start: int = 0) -> Iterator[Batch]:
@@ -159,11 +224,14 @@ class StreamingTFRecordImages:
                  decode_threads: int = 8,
                  augment: bool = False,
                  fast_decode: bool = False,
-                 label_offset: int = 0):
+                 label_offset: int = 0,
+                 max_skipped_per_epoch: int = MAX_SKIPPED_PER_EPOCH):
         if global_batch % num_processes:
             raise ValueError(
                 f"global_batch {global_batch} not divisible by "
                 f"{num_processes} processes")
+        self._skip = {"epoch": 0, "count": 0, "total": 0,
+                      "cap": max_skipped_per_epoch}
         from .tfrecord import split_shards
         self.shards = split_shards(data_dir, split)
         if not self.shards:
@@ -249,9 +317,11 @@ class StreamingTFRecordImages:
                                  fast=self.fast_decode)
             return x, label
 
-        out = list(self._pool.map(one, indices))
-        return {"x": np.stack([x for x, _ in out]),
-                "y": np.asarray([y for _, y in out], np.int32)}
+        if self._skip["epoch"] != epoch:     # per-epoch skip-cap window
+            self._skip.update(epoch=epoch, count=0)
+        return _decode_resilient(self._pool, indices, one,
+                                 skip_state=self._skip,
+                                 what=f"tfrecord stream epoch {epoch}")
 
     def epoch_batches(self, epoch: int | None = None,
                       start: int = 0) -> Iterator[Batch]:
@@ -361,7 +431,8 @@ class StreamingSource:
                 augment=self.augment, fast_decode=self.fast_decode)
         if start_step > 0:
             self._folder.skip(start_step)
-        it = iter(self._folder)
+        # same fault seam as make_loader: identity when injection is inert
+        it = faults.guard_iterator(iter(self._folder))
         depth = self.prefetch if prefetch is None else prefetch
         return PrefetchIterator(it, depth) if depth > 0 else it
 

@@ -129,11 +129,28 @@ class SyncReplicas:
                  sync: SyncConfig | None = None,
                  rules: ShardingRules | None = None,
                  donate: bool = True,
-                 debug_checks: bool = False):
+                 debug_checks: bool = False,
+                 anomaly_policy: str = "halt"):
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh
         self.sync = sync or SyncConfig()
+        if anomaly_policy not in ("halt", "skip", "rollback"):
+            raise ValueError(
+                f"anomaly_policy must be halt|skip|rollback, got "
+                f"{anomaly_policy!r}")
+        # on-device anomaly handling (no per-step host sync): every policy
+        # guards the update — a step whose loss or global grad-norm is
+        # non-finite applies the IDENTITY update (params, optimizer state,
+        # extras, and the step rng all keep their previous values; only
+        # the step counter and anomaly_count advance), so non-finite
+        # numbers can never enter the training state. Under skip/rollback
+        # the step's metrics are additionally sanitized to a -1.0
+        # sentinel (the update never happened; publishing its NaN loss
+        # would poison the metric stream the policies promise to keep
+        # finite). Under halt the raw values are published — they are
+        # the debugging evidence, and NanHook keys off them.
+        self.anomaly_policy = anomaly_policy
         self.rules = rules or ShardingRules(
             fsdp_axis_size=mesh.shape[AxisNames.FSDP])
         self.num_replicas = batch_axis_size(mesh)
@@ -273,12 +290,34 @@ class SyncReplicas:
         updates, opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
         params = optax.apply_updates(state.params, updates)
+        grad_norm = optax.global_norm(grads)
+        # on-device finite-check of loss and global grad-norm, folded into
+        # the compiled step (NanHook's per-step host sync is the debug
+        # fallback). For a finite step the cond takes the computed branch
+        # unchanged, so a healthy run's state and metric stream stay
+        # BIT-IDENTICAL to the unguarded update.
+        finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+        params, opt_state, extras, rng = lax.cond(
+            finite,
+            lambda: (params, opt_state, new_extras,
+                     jax.random.fold_in(state.rng, state.step)),
+            # identity update: optimizer state and the step rng untouched
+            # for the anomalous batch
+            lambda: (state.params, state.opt_state, state.extras,
+                     state.rng))
+        anomaly_count = state.anomaly_count + (
+            1 - finite.astype(jnp.int32))
         new_state = state.replace(
             step=state.step + 1, params=params, opt_state=opt_state,
-            extras=new_extras,
-            rng=jax.random.fold_in(state.rng, state.step))
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads),
-                   **aux}
+            extras=extras, rng=rng, anomaly_count=anomaly_count)
+        metrics = {"loss": loss, "grad_norm": grad_norm, **aux}
+        if self.anomaly_policy in ("skip", "rollback"):
+            # the update was skipped: publish the -1.0 skipped sentinel
+            # (the token_accuracy_every_n convention) instead of values
+            # that never reached the state
+            metrics = jax.tree_util.tree_map(
+                lambda v: jnp.where(finite, v, -jnp.ones_like(v)), metrics)
+        metrics["anomaly_count"] = anomaly_count
         return new_state, metrics
 
     def _auto_step(self, state: TrainState, batch):

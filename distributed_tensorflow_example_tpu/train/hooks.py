@@ -168,6 +168,95 @@ class CheckpointSaverHook(Hook):
         self.manager.wait()        # async writes must land before exit
 
 
+class AnomalyPolicyHook(Hook):
+    """The --on_anomaly policy driver (halt | skip | rollback).
+
+    Detection itself is ON-DEVICE (SyncReplicas folds a finite-check of
+    loss and global grad-norm into the compiled step and carries a
+    cumulative ``anomaly_count`` in TrainState), so this hook adds NO
+    per-step host sync: it observes the count at the metrics cadence the
+    LoggingHook already materializes (``every_steps``), which means a
+    healthy run's dispatch queue is untouched and an anomalous run is
+    acted on at most one cadence window late — by which point the
+    on-device identity update has already kept the bad step out of the
+    training state. NanHook (per-step sync, raises at the exact step)
+    remains the debug fallback.
+
+    Policies, on observing new anomalies:
+
+    - ``halt``: log a summary and request a clean stop (the state holds
+      the last-good params — the identity update never let the
+      non-finite step in — so the end-of-run checkpoint is sound).
+    - ``skip``: keep training (the device already skipped the bad
+      updates); halt with a summary once the run's anomaly budget
+      (``max_anomalies``) is exceeded.
+    - ``rollback``: ask the Trainer to restore the last verified
+      checkpoint and replay the data stream (Megatron-style
+      skip-bad-step + rollback-on-divergence practice); budget as above.
+    """
+
+    def __init__(self, policy: str, max_anomalies: int,
+                 every_steps: int = 100):
+        if policy not in ("halt", "skip", "rollback"):
+            raise ValueError(f"unknown anomaly policy {policy!r}")
+        self.policy = policy
+        self.max_anomalies = max_anomalies
+        self.every_steps = max(1, every_steps)
+        self.observed = 0       # device-counter watermark (cumulative)
+        self.baseline = 0       # counter value when this run began
+        self.last_clean_step = 0
+
+    def begin(self, trainer):
+        # budget window = this train() call: anomalies a restored
+        # checkpoint carries from an earlier incarnation are history,
+        # not charges against this run's budget — the budget compares
+        # against (counter - baseline), never the raw counter
+        self.observed = self.baseline = (
+            int(jax.device_get(trainer.state.anomaly_count))
+            if trainer.state is not None else 0)
+        self.last_clean_step = int(getattr(trainer, "start_step", 0) or 0)
+
+    def _summary(self, step: int, total: int) -> str:
+        return (f"anomaly policy {self.policy!r}: {total} anomalous "
+                f"step(s) (non-finite loss or grad-norm) observed by "
+                f"step {step}; every one was excluded from the training "
+                "state by the on-device identity update. Rerun with "
+                "--check_nans (exact step) or --debug_checks (exact op) "
+                "to localize the source.")
+
+    def after_step(self, trainer, step, metrics):
+        if metrics is None or not self.wants_metrics(step):
+            return
+        count = int(metrics.get("anomaly_count", 0))
+        if count <= self.observed:
+            # every step up to here verified finite: a future rollback
+            # must not land past this point, or the anomalous window
+            # (whose updates were skipped) would be baked into the
+            # restored trajectory instead of repaired by the replay
+            self.last_clean_step = step
+            return
+        self.observed = count
+        total = count - self.baseline      # THIS run's anomalies only
+        if self.policy == "halt":
+            log.error("%s — halting (state holds the last finite "
+                      "update).", self._summary(step, total))
+            return True
+        if total > self.max_anomalies:
+            log.error("%s Budget --max_anomalies=%d EXCEEDED — halting.",
+                      self._summary(step, total), self.max_anomalies)
+            return True
+        if self.policy == "skip":
+            log.warning("%s Continuing (%d/%d of the anomaly budget "
+                        "spent).", self._summary(step, total), total,
+                        self.max_anomalies)
+            return
+        log.warning("%s Requesting rollback to the last verified "
+                    "checkpoint at or before clean step %d (%d/%d of the "
+                    "anomaly budget spent).", self._summary(step, total),
+                    self.last_clean_step, total, self.max_anomalies)
+        trainer.request_rollback(before_step=self.last_clean_step)
+
+
 class NanHook(Hook):
     """Stop (or raise) on NaN/Inf loss — NanTensorHook parity. Forces a
     per-step host sync; enable only when debugging (obs.check_nans)."""

@@ -21,7 +21,8 @@ PyTree = Any
 
 
 @partial(jax.tree_util.register_dataclass,
-         data_fields=("step", "params", "opt_state", "extras", "rng"),
+         data_fields=("step", "params", "opt_state", "extras", "rng",
+                      "anomaly_count"),
          meta_fields=())
 @dataclasses.dataclass
 class TrainState:
@@ -30,6 +31,14 @@ class TrainState:
     ``extras`` holds non-trained mutable model state (e.g. BatchNorm running
     statistics) — the analogue of the reference's non-trainable Variables,
     which also lived on the PS but received no gradients.
+
+    ``anomaly_count`` is the cumulative number of steps whose loss or
+    global grad-norm came back non-finite (the on-device anomaly
+    detector in :class:`~..parallel.sync_replicas.SyncReplicas`). It
+    lives in carried state — not in the per-step metrics — so anomalies
+    inside a K-step ``multi_step`` scan, or on steps no hook observes,
+    still surface at the next metrics materialization without any
+    per-step host sync.
     """
 
     step: jax.Array            # i32 scalar
@@ -37,6 +46,8 @@ class TrainState:
     opt_state: PyTree
     extras: PyTree             # non-trained model state ({} when unused)
     rng: jax.Array             # PRNG key threaded through dropout etc.
+    anomaly_count: jax.Array = dataclasses.field(   # i32 scalar
+        default_factory=lambda: jnp.zeros((), jnp.int32))
 
     @classmethod
     def create(cls, *, params: PyTree, tx: optax.GradientTransformation,
@@ -45,7 +56,8 @@ class TrainState:
         if isinstance(rng, int):
             rng = jax.random.key(rng)
         return cls(step=jnp.zeros((), jnp.int32), params=params,
-                   opt_state=tx.init(params), extras=extras or {}, rng=rng)
+                   opt_state=tx.init(params), extras=extras or {}, rng=rng,
+                   anomaly_count=jnp.zeros((), jnp.int32))
 
     def replace(self, **kw: Any) -> "TrainState":
         return dataclasses.replace(self, **kw)

@@ -31,10 +31,11 @@ import jax
 import numpy as np
 
 from ..ckpt.checkpoint import CheckpointManager, restore_or_init
-from ..config import TrainConfig
+from ..config import TrainConfig, anomaly_settings
 from ..data.loader import make_loader
 from ..parallel.mesh import batch_axis_size, build_mesh
 from ..parallel.sync_replicas import SyncReplicas
+from ..runtime import faults
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsLogger
 from . import hooks as hooks_lib
@@ -88,9 +89,22 @@ class Trainer:
         self.tx = make_optimizer(config.optimizer)
         self._schedule = make_schedule(config.optimizer)
         rules = model.sharding_rules(config.mesh)
+        # self-healing config (validated before any trace): the anomaly
+        # policy shapes the compiled step (identity update + metric
+        # sanitization) and the policy hook; the fault spec arms the
+        # injection seams process-wide (inert when empty)
+        anomaly_settings(config)
+        self._rollback_pending = False
+        self._rollback_before: int | None = None
+        self._faults_installed = False
+        if config.fault_spec:
+            faults.install(faults.parse_spec(config.fault_spec,
+                                             seed=config.seed))
+            self._faults_installed = True
         self.sync = SyncReplicas(model.loss, self.tx, self.mesh,
                                  sync=config.sync, rules=rules,
-                                 debug_checks=config.obs.debug_checks)
+                                 debug_checks=config.obs.debug_checks,
+                                 anomaly_policy=config.on_anomaly)
 
         self.ckpt_manager = (
             CheckpointManager(config.checkpoint.directory,
@@ -169,6 +183,21 @@ class Trainer:
                                       batch_size=cfg.data.batch_size,
                                       metrics_logger=self.metrics_logger),
         ]
+        # anomaly policy driver: rides the log cadence so it adds NO
+        # metric materializations a default run doesn't already pay.
+        # With logging tuned OFF (log_every_steps=0): under the default
+        # 'halt' policy the hook is omitted entirely — the on-device
+        # identity update still protects the state, and a run that
+        # disabled host syncs keeps zero of them; an EXPLICIT
+        # skip/rollback policy is a request for active healing, so it
+        # gets a 100-step fallback cadence (rounded to a loop boundary)
+        spl = max(1, cfg.steps_per_loop)
+        every = cfg.obs.log_every_steps
+        if not every and cfg.on_anomaly != "halt":
+            every = ((100 + spl - 1) // spl) * spl
+        if every:
+            hs.append(hooks_lib.AnomalyPolicyHook(
+                cfg.on_anomaly, cfg.max_anomalies, every_steps=every))
         if cfg.obs.summary_every_steps:
             hs.append(hooks_lib.SummaryHook(self.metrics_logger,
                                             cfg.obs.summary_every_steps))
@@ -242,13 +271,19 @@ class Trainer:
                          self.config.checkpoint.warm_start)
         return state
 
-    def _loader(self) -> Iterator[dict[str, np.ndarray]]:
+    def _loader(self, start_step: int | None = None
+                ) -> Iterator[dict[str, np.ndarray]]:
+        """Batch iterator fast-forwarded to ``start_step`` (default: the
+        run's start step). Rollback rebuilds the loader through the same
+        exact-resume machinery, aimed at the restored step."""
+        if start_step is None:
+            start_step = self.start_step
         if hasattr(self.train_arrays, "make_loader"):
             # streaming source (e.g. data.streaming.StreamingSource):
             # batches are materialized on demand instead of held in RAM
             return self.train_arrays.make_loader(
                 self.config.data.batch_size,
-                start_step=self.start_step,
+                start_step=start_step,
                 process_index=self.process_index,
                 num_processes=self.num_processes,
                 shuffle=self.config.data.shuffle,
@@ -258,7 +293,7 @@ class Trainer:
             self.train_arrays, self.config.data.batch_size,
             prefetch=self.config.data.prefetch,
             native=self.config.data.native,
-            start_step=self.start_step,   # exact-resume: skip consumed batches
+            start_step=start_step,        # exact-resume: skip consumed batches
             process_index=self.process_index,
             num_processes=self.num_processes,
             shuffle=self.config.data.shuffle,
@@ -305,6 +340,9 @@ class Trainer:
             raise ValueError(f"max_inflight_steps must be >= 0, got "
                              f"{max_inflight}")
         pending = 0
+        self._rollback_pending = False
+        fault_reg = faults.active()
+        loader = None
         try:
             # begin() inside the try: a failing begin (or anything after a
             # partial begin) must still run every hook's end() — hooks
@@ -320,6 +358,12 @@ class Trainer:
                     # K steps per dispatch (iterations_per_loop analogue):
                     # stack K host batches on a leading loop axis and scan
                     stack = [next(loader) for _ in range(spl)]
+                    if fault_reg is not None:
+                        # step.* faults poison the HOST batch producing
+                        # the matching global step (bad-batch semantics;
+                        # the compiled program is untouched)
+                        stack = [fault_reg.poison_batch(b, step + i + 1)
+                                 for i, b in enumerate(stack)]
                     stacked = {k: np.stack([b[k] for b in stack])
                                for k in stack[0]}
                     batch = self.sync.shard_stacked_batch(stacked)
@@ -330,7 +374,11 @@ class Trainer:
                     state, device_metrics = self.sync.multi_step(state, batch)
                     step += spl
                 else:
-                    batch = self.sync.shard_batch(next(loader))
+                    host_batch = next(loader)
+                    if fault_reg is not None:
+                        host_batch = fault_reg.poison_batch(host_batch,
+                                                            step + 1)
+                    batch = self.sync.shard_batch(host_batch)
                     if want_aot:
                         self.sync.precompile(state, batch)
                         want_aot = False
@@ -357,6 +405,16 @@ class Trainer:
                     if h.after_step(self, step, host_metrics):
                         stop = True
 
+                if self._rollback_pending and not stop:
+                    rolled = self._perform_rollback(step, loader)
+                    if rolled is None:
+                        stop = True            # nothing valid to restore
+                    else:
+                        state, step, loader = rolled
+                        # skip this iteration's eval: the state it would
+                        # measure was just discarded
+                        continue
+
                 if (self.config.eval_every_steps
                         and step % self.config.eval_every_steps == 0
                         and self.eval_arrays is not None):
@@ -377,6 +435,8 @@ class Trainer:
             # FloatingPointError is its *default* behavior) — the reference's
             # Supervisor shutdown still saved and closed services. A hook
             # end() error must not mask an in-flight loop exception.
+            if loader is not None and hasattr(loader, "close"):
+                loader.close()       # release the prefetch thread
             import sys as _sys
             in_flight = _sys.exc_info()[0] is not None
             end_error: Exception | None = None
@@ -412,6 +472,76 @@ class Trainer:
                 summary["eval"] = self.evaluate(state)
                 self._maybe_save_best(state, step, summary["eval"])
         return state, summary
+
+    # ------------------------------------------------------------------
+    def request_rollback(self, before_step: int | None = None) -> None:
+        """Ask the training loop to restore the last VERIFIED checkpoint
+        at the next step boundary (the --on_anomaly=rollback action;
+        called by AnomalyPolicyHook). ``before_step`` caps the restore
+        target at the last step known anomaly-free, so the replay REDOES
+        the anomalous window (with the transient fault gone) instead of
+        baking its skipped updates into the trajectory. Deterministic
+        across processes: every process observes the same device-computed
+        anomaly count at the same cadence, so every process requests
+        together with the same cap."""
+        self._rollback_pending = True
+        self._rollback_before = before_step
+
+    def _perform_rollback(self, step: int, old_loader=None):
+        """Restore the newest checkpoint ≤ the requested clean step that
+        passes CRC verification, and fast-forward the data stream to it
+        (the exact-resume machinery, aimed backward). Returns ``(state,
+        step, loader)`` or None when no verified checkpoint exists in
+        range (caller halts)."""
+        self._rollback_pending = False
+        if old_loader is not None and hasattr(old_loader, "close"):
+            old_loader.close()      # release the prefetch thread + queue
+        before = self._rollback_before
+        mgr = self.ckpt_manager
+        mgr.wait()
+        # run-scoped accounting, not model state: the budget must keep
+        # charging across the restore or a divergence loop would spin
+        # rollbacks forever inside a never-spent budget
+        pre_count = self.state.anomaly_count
+        if self.num_processes > 1:
+            # multi-host: the chief's verification read picks the step,
+            # every process then restores it — the probe read is the
+            # price of the broadcast agreement
+            from ..ckpt.checkpoint import _agreed_latest_step
+            target = _agreed_latest_step(mgr, max_step=before)
+            if target is None:
+                log.error("rollback requested at step %d but no verified "
+                          "checkpoint at or before clean step %s exists "
+                          "under %r — halting", step, before, mgr.directory)
+                return None
+            state = mgr.restore(self.state, step=target)
+        else:
+            # single-process: verify WHILE restoring (one read of the
+            # chosen checkpoint, walking past corrupt candidates)
+            try:
+                state = mgr.restore(self.state, step=None, max_step=before)
+            except FileNotFoundError as e:   # incl. CorruptCheckpointError
+                log.error("rollback requested at step %d but no verified "
+                          "checkpoint at or before clean step %s exists "
+                          "under %r (%s) — halting",
+                          step, before, mgr.directory, e)
+                return None
+            target = int(jax.device_get(state.step))
+        state = state.replace(anomaly_count=pre_count)
+        self.state = state
+        # truncate the rejected trajectory: checkpoints newer than the
+        # restore target embed the skipped-update window — a preemption
+        # during the replay must not hand restore_or_init the very
+        # trajectory this rollback discarded
+        discarded = mgr.discard_steps_above(target)
+        if discarded:
+            log.warning("rollback: discarded rejected-trajectory "
+                        "checkpoint step(s) %s", discarded)
+        loader = self._loader(start_step=target)
+        log.warning("rollback: restored verified checkpoint step %d "
+                    "(training was at step %d); data stream "
+                    "fast-forwarded to match", target, step)
+        return state, target, loader
 
     # early-stop progress survives preemption in a sidecar next to the
     # checkpoints (the counters are host-side floats, not state leaves)
@@ -500,13 +630,30 @@ class Trainer:
     # ------------------------------------------------------------------
     def close(self) -> None:
         """Release owned resources (the metrics JSONL handle, the async
-        checkpoint writer). The Trainer owns these — hooks must not close
-        them."""
-        if self.ckpt_manager is not None:
-            self.ckpt_manager.close()
-        self.metrics_logger.close()
-        if hasattr(self.train_arrays, "close"):
-            self.train_arrays.close()     # streaming source: decode pool
+        checkpoint writer, an installed fault registry). The Trainer owns
+        these — hooks must not close them. A pending async-save error
+        SURFACES from ckpt_manager.close(); the remaining resources are
+        still released first (a failed final write must not also leak
+        the decode pool or leave fault injection armed for the next
+        Trainer in this process)."""
+        # each resource releases regardless of the others failing — a
+        # metrics-flush ENOSPC must not leave the fault registry armed
+        # for the next Trainer in this process, or leak the decode pool
+        try:
+            self.metrics_logger.close()
+        finally:
+            try:
+                if hasattr(self.train_arrays, "close"):
+                    self.train_arrays.close()  # streaming: decode pool
+            finally:
+                try:
+                    if self._faults_installed:
+                        faults.install(None)
+                        self._faults_installed = False
+                finally:
+                    if self.ckpt_manager is not None:
+                        # raises a pending async write error (once)
+                        self.ckpt_manager.close()
 
     def __enter__(self) -> "Trainer":
         return self

@@ -1,0 +1,113 @@
+"""Worker for the two-process checkpoint-corruption test (not pytest).
+
+Run as: python _two_process_corrupt_worker.py <process_id> <coord_port>
+<outdir>
+
+Exercises the multi-host half of the verified-checkpoint story that
+single-process tests cannot: ``_agreed_latest_step`` must have the CHIEF
+probe integrity (CRC32 + shard presence) and broadcast the newest VALID
+step, so both processes restore the same fallback when the latest
+checkpoint is corrupt — instead of one process crashing on a bad file
+while the other restores, which deadlocks the first collective.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import glob
+
+import numpy as np
+
+from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+    CheckpointManager, _agreed_latest_step, restore_or_init)
+from distributed_tensorflow_example_tpu.cluster import ClusterSpec
+from distributed_tensorflow_example_tpu.config import (MeshShape,
+                                                       OptimizerConfig)
+from distributed_tensorflow_example_tpu.models.mlp import MLP
+from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_example_tpu.parallel.sharding import ShardingRules
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.runtime import distributed as rt
+from distributed_tensorflow_example_tpu.train.optimizers import make_optimizer
+
+
+def _truncate(path: str) -> None:
+    with open(path, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(path) // 2))
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    port = int(sys.argv[2])
+    outdir = sys.argv[3]
+
+    cluster = ClusterSpec({"worker": [f"localhost:{port}",
+                                      f"localhost:{port + 1}"]})
+    ctx = rt.initialize(cluster, "worker", pid)
+    assert ctx.num_processes == 2, ctx
+
+    # fsdp over processes: params NOT fully addressable, so saves gather
+    # cross-host and restores re-place — the real multi-host shapes
+    mesh = build_mesh(MeshShape(data=2, fsdp=4))
+    model = MLP(in_dim=20, hidden=16, num_classes=4)
+    tx = make_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1))
+    sync = SyncReplicas(model.loss, tx, mesh,
+                        rules=ShardingRules(fsdp_axis_size=4,
+                                            fsdp_min_size=1))
+    state = sync.init(model.init, seed=0)
+
+    ckpt_dir = os.path.join(outdir, "ckpt")    # shared filesystem
+    mgr = CheckpointManager(ckpt_dir)
+
+    mgr.save(state, step=4)
+    mgr.save(state, step=8)
+    rt.barrier("saved-both")
+    assert _agreed_latest_step(mgr) == 8
+
+    # chief damages the LATEST checkpoint; both processes must agree on
+    # the fallback step 4 through the broadcast
+    if pid == 0:
+        _truncate(mgr.checkpoint_path(8))
+    rt.barrier("corrupted-latest")
+    agreed = _agreed_latest_step(mgr)
+    assert agreed == 4, f"proc {pid}: agreed {agreed}, want fallback 4"
+    restored, was_restored = restore_or_init(
+        mgr, lambda: sync.init(model.init, seed=0))
+    assert was_restored
+    rt.barrier("restored-fallback")
+
+    # sharded format: every process writes its own shard of step 12;
+    # deleting ONE shard must invalidate the whole step for BOTH
+    sh_mgr = CheckpointManager(ckpt_dir, sharded=True)
+    sh_mgr.save(state, step=12)
+    rt.barrier("sharded-saved")
+    assert _agreed_latest_step(sh_mgr) == 12
+    if pid == 0:
+        victim = sorted(glob.glob(os.path.join(
+            ckpt_dir, "ckpt-12.shard-*.npz")))[-1]
+        os.remove(victim)
+    rt.barrier("shard-deleted")
+    agreed = _agreed_latest_step(sh_mgr)
+    assert agreed == 4, f"proc {pid}: agreed {agreed} after shard loss"
+    restored, was_restored = restore_or_init(
+        sh_mgr, lambda: sync.init(model.init, seed=0))
+    assert was_restored
+    rt.barrier("done")
+    print(f"proc {pid}: corrupt-fallback broadcast OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

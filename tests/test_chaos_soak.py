@@ -1,0 +1,32 @@
+"""Full chaos soak as a test (slow lane): every seeded
+kill/corrupt/NaN/flaky-IO scenario in experiments/chaos_soak.py must
+hold its recovery invariant. Tier-1 keeps a fast smoke of the same
+contract in tests/test_self_healing.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow      # ~10 Trainer runs, fresh process
+
+
+def test_chaos_soak_all_scenarios():
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "experiments", "chaos_soak.py")
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    out = subprocess.run([sys.executable, script, "--scenario", "all"],
+                         env=env, capture_output=True, text=True,
+                         timeout=900)
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, f"no scenario output:\n{out.stdout}\n{out.stderr[-2000:]}"
+    bad = [r for r in rows if not r["ok"]]
+    assert not bad, f"failed scenarios: {bad}"
+    assert out.returncode == 0
+    assert len(rows) == 7, [r["scenario"] for r in rows]

@@ -1,0 +1,238 @@
+"""Checkpoint corruption: CRC32 verification, valid-step fallback,
+CorruptCheckpointError semantics, write durability, async error
+surfacing — the verified-checkpoint half of the self-healing story.
+
+Covers truncate / zero-fill / delete-one-shard damage for BOTH on-disk
+formats (single-file ``.npz`` and sharded), the ``latest_valid_step``
+probe, ``restore_or_init`` fallback, and the CheckpointManager.close()
+contract (a pending async write error surfaces instead of vanishing).
+The multi-host broadcast path of the fallback
+(``_agreed_latest_step``) runs in tests/test_two_process_corruption.py
+(slow lane, real two-process cluster).
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.ckpt.checkpoint import (
+    CheckpointManager, CorruptCheckpointError, restore_or_init)
+from distributed_tensorflow_example_tpu.config import (MeshShape,
+                                                       OptimizerConfig)
+from distributed_tensorflow_example_tpu.models.mlp import MLP
+from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_example_tpu.parallel.sharding import ShardingRules
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.runtime import faults
+from distributed_tensorflow_example_tpu.train.optimizers import make_optimizer
+
+
+def _state(v=0.0):
+    return {"w": jnp.full((64,), v), "step": jnp.asarray(7, jnp.int32)}
+
+
+def _truncate(path):
+    with open(path, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(path) // 2))
+
+
+def _zero_fill(path):
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 3)
+        f.write(b"\0" * max(1, size // 3))
+
+
+DAMAGE = {"truncate": _truncate, "zero": _zero_fill, "delete": os.remove}
+
+
+# ---------------------------------------------------------------------------
+# single-file format
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("damage", ["truncate", "zero", "delete"])
+def test_corrupt_latest_falls_back_to_previous_valid(tmp_path, damage):
+    mgr = CheckpointManager(str(tmp_path))
+    for s in (1, 2, 3):
+        mgr.save(_state(float(s)), step=s)
+    DAMAGE[damage](mgr.checkpoint_path(3))
+    assert mgr.latest_valid_step() == 2
+    out = mgr.restore(_state(0.0))          # default step: newest VALID
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+
+
+def test_explicit_corrupt_step_raises_named_error(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), step=5)
+    _zero_fill(mgr.checkpoint_path(5))
+    with pytest.raises(CorruptCheckpointError) as ei:
+        mgr.restore(_state(0.0), step=5)
+    msg = str(ei.value)
+    assert "5" in msg and "ckpt-5.npz" in msg   # names step and file
+
+
+def test_all_corrupt_raises_with_fallback_trail(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    for s in (1, 2):
+        mgr.save(_state(float(s)), step=s)
+    _truncate(mgr.checkpoint_path(1))
+    _truncate(mgr.checkpoint_path(2))
+    with pytest.raises(CorruptCheckpointError, match="no fallback"):
+        mgr.restore(_state(0.0))
+    assert mgr.latest_valid_step() is None
+
+
+def test_crc_catches_zip_surviving_bitrot(tmp_path):
+    """Flip bytes INSIDE an npy payload while keeping sizes intact: the
+    zip layer may or may not notice, the recorded CRC32 must."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(1.0), step=1)
+    path = mgr.checkpoint_path(1)
+    data = bytearray(open(path, "rb").read())
+    # flip a byte in the middle of the 'w' payload region
+    probe = data.find(b"w.npy")
+    assert probe != -1
+    data[probe + 200] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(CorruptCheckpointError):
+        mgr.restore(_state(0.0), step=1)
+
+
+def test_restore_or_init_broadcast_path_falls_back(tmp_path):
+    """Single-process _agreed_latest_step goes through latest_valid_step
+    — restore_or_init must pick the valid step, not the corrupt latest."""
+    mgr = CheckpointManager(str(tmp_path))
+    for s in (1, 2):
+        mgr.save(_state(float(s)), step=s)
+    _truncate(mgr.checkpoint_path(2))
+    state, restored = restore_or_init(mgr, lambda: _state(0.0))
+    assert restored
+    np.testing.assert_allclose(np.asarray(state["w"]), 1.0)
+
+
+def test_pre_crc_checkpoints_still_restore(tmp_path):
+    """Back-compat: a checkpoint written without the __crc32__ record
+    (pre-verification format) loads — content-unverified but working."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(3.0), step=1)
+    path = mgr.checkpoint_path(1)
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__crc32__"}
+    np.savez(path.replace(".npz", "") , **arrays)   # plain rewrite
+    out = mgr.restore(_state(0.0), step=1)
+    np.testing.assert_allclose(np.asarray(out["w"]), 3.0)
+
+
+# ---------------------------------------------------------------------------
+# sharded format
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sharded_mgr(tmp_path):
+    mesh = build_mesh(MeshShape(data=2, fsdp=4))
+    model = MLP(in_dim=20, hidden=16, num_classes=4)
+    tx = make_optimizer(OptimizerConfig(name="adam", learning_rate=0.1))
+    sync = SyncReplicas(model.loss, tx, mesh,
+                        rules=ShardingRules(fsdp_axis_size=4,
+                                            fsdp_min_size=1))
+    state = sync.init(model.init, seed=0)
+    mgr = CheckpointManager(str(tmp_path), sharded=True)
+    for s in (1, 2):
+        mgr.save(state, step=s)
+    return mgr, sync, state
+
+
+@pytest.mark.parametrize("damage", ["truncate", "zero", "delete"])
+def test_sharded_corrupt_shard_falls_back(sharded_mgr, damage):
+    mgr, sync, state = sharded_mgr
+    shards = sorted(glob.glob(os.path.join(mgr.directory,
+                                           "ckpt-2.shard-*.npz")))
+    assert shards
+    DAMAGE[damage](shards[0])
+    assert mgr.latest_valid_step() == 1
+    out = mgr.restore(state)                 # falls back to step 1
+    assert int(jax.device_get(out.step)) == int(jax.device_get(state.step))
+
+
+def test_sharded_corrupt_anchor_falls_back(sharded_mgr):
+    mgr, sync, state = sharded_mgr
+    _truncate(mgr.shard_anchor_path(2))
+    assert mgr.latest_valid_step() == 1
+
+
+def test_sharded_explicit_step_raises_corrupt_error(sharded_mgr):
+    mgr, sync, state = sharded_mgr
+    for p in glob.glob(os.path.join(mgr.directory, "ckpt-2.shard-*.npz")):
+        _zero_fill(p)
+    with pytest.raises(CorruptCheckpointError):
+        mgr.restore(state, step=2)
+
+
+# ---------------------------------------------------------------------------
+# write durability + async error surfacing (satellite: close() contract)
+# ---------------------------------------------------------------------------
+
+def test_async_save_error_surfaces_at_close(tmp_path):
+    reg = faults.parse_spec("ckpt.write:step=1:raise=OSError")
+    faults.install(reg)
+    try:
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(_state(1.0), step=1)       # write fails on the thread
+        with pytest.raises(OSError, match="injected fault"):
+            mgr.close()
+        # close() must still have released the executor despite raising
+        assert mgr._executor._shutdown
+    finally:
+        faults.install(None)
+
+
+def test_async_save_error_surfaces_at_next_save(tmp_path):
+    reg = faults.parse_spec("ckpt.write:step=1:raise=OSError")
+    faults.install(reg)
+    try:
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(_state(1.0), step=1)
+        with pytest.raises(OSError, match="injected fault"):
+            mgr.save(_state(2.0), step=2)   # drain surfaces the error
+        mgr.close()
+    finally:
+        faults.install(None)
+
+
+def test_commit_fault_leaves_no_half_commit(tmp_path):
+    """A crash between the data write and the state-file commit must not
+    confuse restore: the state file never names the new step."""
+    reg = faults.parse_spec("ckpt.commit:step=2:raise=OSError")
+    faults.install(reg)
+    try:
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(_state(1.0), step=1)
+        with pytest.raises(OSError):
+            mgr.save(_state(2.0), step=2)
+        assert mgr.latest_step() == 1        # uncommitted write invisible
+        out = mgr.restore(_state(0.0))
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    finally:
+        faults.install(None)
+
+
+def test_injected_write_fault_then_clean_retry(tmp_path):
+    """A failed synchronous save leaves the ring usable; a later save of
+    the same step succeeds (no stale tmp files, no poisoned state file)."""
+    reg = faults.parse_spec("ckpt.write:step=1:raise=OSError")
+    faults.install(reg)
+    try:
+        mgr = CheckpointManager(str(tmp_path))
+        with pytest.raises(OSError):
+            mgr.save(_state(1.0), step=1)
+        assert not glob.glob(os.path.join(str(tmp_path), "*.tmp"))
+        mgr.save(_state(1.5), step=1)
+        out = mgr.restore(_state(0.0), step=1)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.5)
+    finally:
+        faults.install(None)

@@ -1,0 +1,364 @@
+"""Self-healing training loop: fault injection, anomaly policy, recovery.
+
+Tier-1 smoke of the chaos contract (experiments/chaos_soak.py runs the
+full soak as a ``slow`` test):
+
+- an injected NaN step under --on_anomaly=skip keeps the step count and
+  a finite loss stream (acceptance b);
+- --on_anomaly=rollback restores the last clean verified checkpoint,
+  replays, and converges to the SAME final params as an uninterrupted
+  run (acceptance c, strengthened to divergence repair);
+- a corrupted latest checkpoint falls back to the previous valid step
+  at restart (acceptance a);
+- with no fault spec, the detection-enabled loss stream is bit-identical
+  across policies and the policy hook adds no off-cadence metric
+  materializations (acceptance d);
+- the fault-spec grammar and the anomaly-policy config validate loudly.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import (CheckpointConfig,
+                                                       DataConfig, MeshShape,
+                                                       ObservabilityConfig,
+                                                       OptimizerConfig,
+                                                       TrainConfig,
+                                                       anomaly_settings)
+from distributed_tensorflow_example_tpu.data.mnist import synthetic_mnist
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.parallel.mesh import local_mesh
+from distributed_tensorflow_example_tpu.runtime import faults
+from distributed_tensorflow_example_tpu.train import hooks as hooks_lib
+from distributed_tensorflow_example_tpu.train.trainer import Trainer
+
+DATA = synthetic_mnist(num_train=640, num_test=64, seed=0)
+
+
+def _cfg(steps=12, *, ckpt_dir=None, save_steps=0, on_anomaly="halt",
+         max_anomalies=10, fault_spec="", log_every=4):
+    return TrainConfig(
+        model="mlp", train_steps=steps, mesh=MeshShape(data=4),
+        data=DataConfig(batch_size=64, seed=3),
+        optimizer=OptimizerConfig(name="momentum", learning_rate=0.1),
+        checkpoint=CheckpointConfig(directory=ckpt_dir,
+                                    save_steps=save_steps),
+        obs=ObservabilityConfig(log_every_steps=log_every),
+        on_anomaly=on_anomaly, max_anomalies=max_anomalies,
+        fault_spec=fault_spec, seed=7)
+
+
+def _trainer(cfg, hooks=None):
+    return Trainer(get_model("mlp", cfg), cfg,
+                   {"x": DATA["train_x"], "y": DATA["train_y"]},
+                   mesh=local_mesh(4), process_index=0, num_processes=1,
+                   hooks=hooks)
+
+
+def _params(state):
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(state.params))
+
+
+class LossStream(hooks_lib.Hook):
+    every_steps = 1
+
+    def __init__(self):
+        self.losses = []
+
+    def after_step(self, trainer, step, metrics):
+        if metrics is not None:
+            self.losses.append(float(metrics["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# fault-spec grammar
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parses_and_validates():
+    reg = faults.parse_spec(
+        "ckpt.write:step=2:raise=OSError;loader.next:p=0.5;"
+        "step.nan:step=7;ckpt.write:step=3:corrupt=truncate", seed=1)
+    assert len(reg.rules) == 4
+    for bad in ("nonsense.site:step=1",          # unknown site
+                "loader.next",                   # no trigger
+                "loader.next:step=1:p=0.5",      # two triggers
+                "loader.next:p=1.5",             # p out of range
+                "loader.next:step=0",            # 1-based
+                "loader.next:raise=SystemExit:step=1",   # not allowlisted
+                "loader.next:corrupt=truncate:step=1",   # corrupt != write
+                "loader.next:bogus=1:step=1",    # unknown field
+                ""):                             # no rules at all
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse_spec(bad)
+
+
+def test_fault_step_rules_are_one_shot_and_deterministic():
+    reg = faults.parse_spec("ckpt.read:step=2", seed=0)
+    assert reg.check("ckpt.read") is None          # invocation 1
+    assert reg.check("ckpt.read") is not None      # invocation 2 fires
+    assert reg.check("ckpt.read") is None          # spent: replay-safe
+    # p-rules: same seed -> same firing pattern
+    a = faults.parse_spec("loader.next:p=0.5", seed=9)
+    b = faults.parse_spec("loader.next:p=0.5", seed=9)
+    pattern = [a.check("loader.next") is not None for _ in range(16)]
+    assert pattern == [b.check("loader.next") is not None
+                       for _ in range(16)]
+    assert any(pattern) and not all(pattern)
+
+
+def test_anomaly_config_validates():
+    with pytest.raises(ValueError, match="on_anomaly"):
+        anomaly_settings(_cfg().replace(on_anomaly="explode"))
+    with pytest.raises(ValueError, match="max_anomalies"):
+        anomaly_settings(_cfg().replace(max_anomalies=-1))
+    with pytest.raises(ValueError, match="rollback"):
+        anomaly_settings(_cfg(on_anomaly="skip").replace(
+            on_anomaly="rollback"))     # no checkpoint directory
+    with pytest.raises(ValueError, match="check_nans"):
+        cfg = _cfg(on_anomaly="skip")
+        cfg.obs.check_nans = True       # NanHook can't fire under skip
+        anomaly_settings(cfg)
+    with pytest.raises(SystemExit):
+        from distributed_tensorflow_example_tpu.cli.train import main
+        main(["--fault_spec", "bogus.site:p=0.1", "--train_steps", "1"])
+
+
+# ---------------------------------------------------------------------------
+# on-device detection + policies
+# ---------------------------------------------------------------------------
+
+def test_guarded_update_is_identity_on_nan_batch():
+    """Direct step-level contract: a NaN batch advances step and
+    anomaly_count but leaves params/opt_state/rng untouched."""
+    cfg = _cfg(on_anomaly="skip")
+    t = _trainer(cfg)
+    with t:
+        state = t.initialize()
+        before = _params(state)        # snapshot: step() donates its input
+        batch = {"x": DATA["train_x"][:64] * np.nan,
+                 "y": DATA["train_y"][:64]}
+        new_state, metrics = t.sync.step(state, t.sync.shard_batch(batch))
+        assert int(jax.device_get(new_state.step)) == 1
+        assert int(jax.device_get(new_state.anomaly_count)) == 1
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            before, _params(new_state))
+        # skip policy publishes the skipped sentinel, not the NaN
+        assert float(jax.device_get(metrics["loss"])) == -1.0
+        assert float(jax.device_get(metrics["anomaly_count"])) == 1.0
+
+
+def test_halt_policy_publishes_raw_nan_for_debugging():
+    cfg = _cfg(on_anomaly="halt")
+    t = _trainer(cfg)
+    with t:
+        state = t.initialize()
+        before = _params(state)        # snapshot: step() donates its input
+        batch = {"x": DATA["train_x"][:64] * np.nan,
+                 "y": DATA["train_y"][:64]}
+        new_state, metrics = t.sync.step(state, t.sync.shard_batch(batch))
+        assert not np.isfinite(float(jax.device_get(metrics["loss"])))
+        # ... but the state is still protected
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            before, _params(new_state))
+
+
+def test_nan_skip_keeps_step_count_and_finite_loss_stream():
+    """Acceptance (b): injected NaN under skip == clean run in step count,
+    with a finite loss stream throughout."""
+    with _trainer(_cfg()) as t_ref:
+        _, ref = t_ref.train()
+    stream = LossStream()
+    with _trainer(_cfg(on_anomaly="skip", fault_spec="step.nan:step=7"),
+                  hooks=[stream]) as t:
+        state, summary = t.train()
+    assert summary["final_step"] == ref["final_step"] == 12
+    assert len(stream.losses) == 12
+    assert all(np.isfinite(l) for l in stream.losses)
+    assert int(summary["final_metrics"]["anomaly_count"]) == 1
+
+
+def test_rollback_repairs_divergence_to_uninterrupted_parity(tmp_path):
+    """Acceptance (c), strengthened: rollback restores the last CLEAN
+    verified checkpoint, replays the window (fault spent), and lands on
+    the SAME final params as a run that never saw the fault."""
+    with _trainer(_cfg(20)) as t_ref:
+        s_ref, ref = t_ref.train()
+    ck = str(tmp_path / "ckpt")
+    with _trainer(_cfg(20, ckpt_dir=ck, save_steps=5,
+                       on_anomaly="rollback", log_every=5,
+                       fault_spec="step.nan:step=8")) as t:
+        s, summary = t.train()
+    assert summary["final_step"] == ref["final_step"] == 20
+    assert int(summary["final_metrics"]["anomaly_count"]) == 1
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6,
+                                                atol=1e-7),
+        _params(s_ref), _params(s))
+
+
+def test_anomaly_budget_halts_with_summary(tmp_path):
+    spec = ";".join(f"step.nan:step={s}" for s in (2, 4, 6, 8, 10))
+    with _trainer(_cfg(20, on_anomaly="skip", max_anomalies=2,
+                       log_every=2, fault_spec=spec)) as t:
+        state, summary = t.train()
+    assert summary["final_step"] < 20
+    assert int(summary["final_metrics"]["anomaly_count"]) > 2
+
+
+def test_loader_faults_are_retried_transparently():
+    with _trainer(_cfg(8, fault_spec="loader.next:step=3")) as t:
+        state, summary = t.train()
+    assert summary["final_step"] == 8
+    assert int(summary["final_metrics"]["anomaly_count"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance (d): healthy runs are unchanged by the machinery
+# ---------------------------------------------------------------------------
+
+def test_healthy_loss_stream_bit_identical_across_policies(tmp_path):
+    """No --fault_spec: the guarded update's finite branch must be the
+    plain update — the metric stream is BIT-identical whichever policy is
+    armed (and therefore identical to the unguarded pre-detection step,
+    whose math the finite branch reproduces verbatim)."""
+    streams = {}
+    finals = {}
+    for policy in ("halt", "skip", "rollback"):
+        kw = (dict(ckpt_dir=str(tmp_path / "rb"), save_steps=4)
+              if policy == "rollback" else {})
+        stream = LossStream()
+        with _trainer(_cfg(on_anomaly=policy, **kw),
+                      hooks=[stream]) as t:
+            s, _ = t.train()
+        streams[policy] = stream.losses
+        finals[policy] = _params(s)
+    assert streams["halt"] == streams["skip"] == streams["rollback"]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b),
+        finals["halt"], finals["skip"])
+
+
+def test_policy_hook_adds_no_off_cadence_materialization():
+    """The AnomalyPolicyHook rides the log cadence: wants_metrics is
+    False off-cadence, so a healthy run pays no extra host syncs (the
+    per-step NanHook remains the explicitly-opt-in debug fallback)."""
+    h = hooks_lib.AnomalyPolicyHook("skip", 10, every_steps=100)
+    assert not any(h.wants_metrics(s) for s in range(1, 100))
+    assert h.wants_metrics(100)
+    cfg = _cfg()
+    t = _trainer(cfg)
+    with t:
+        policy_hooks = [x for x in t.hooks
+                        if isinstance(x, hooks_lib.AnomalyPolicyHook)]
+        assert len(policy_hooks) == 1
+        assert policy_hooks[0].every_steps == cfg.obs.log_every_steps
+
+
+# ---------------------------------------------------------------------------
+# acceptance (a): corrupt latest checkpoint -> fallback restore at startup
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("damage", ["truncate", "zero", "delete"])
+def test_trainer_restart_falls_back_past_corrupt_latest(tmp_path, damage):
+    import os
+    ck = str(tmp_path / "ckpt")
+    with _trainer(_cfg(10, ckpt_dir=ck, save_steps=5)) as t:
+        t.train()
+    from distributed_tensorflow_example_tpu.ckpt.checkpoint import \
+        CheckpointManager
+    mgr = CheckpointManager(ck)
+    latest = mgr.latest_step()
+    path = mgr.checkpoint_path(latest)
+    if damage == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+    elif damage == "zero":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 3)
+            f.write(b"\0" * (size // 3))
+    else:
+        os.remove(path)
+    t2 = _trainer(_cfg(10, ckpt_dir=ck, save_steps=5))
+    with t2:
+        t2.initialize()
+        assert t2.start_step == 5, \
+            f"must fall back to step 5, got {t2.start_step}"
+
+
+def test_budget_ignores_restored_anomaly_history():
+    """Regression: the budget charges THIS run's anomalies only — a
+    restored checkpoint carrying anomaly_count=9 must not leave a
+    max_anomalies=2 run with an effective budget of -7."""
+    h = hooks_lib.AnomalyPolicyHook("skip", 2, every_steps=1)
+    h.observed = h.baseline = 9            # as begin() sets after restore
+    assert h.after_step(None, 1, {"anomaly_count": 10}) is None   # 1/2
+    assert h.after_step(None, 2, {"anomaly_count": 11}) is None   # 2/2
+    assert h.after_step(None, 3, {"anomaly_count": 12}) is True   # 3 > 2
+
+
+def test_poison_batch_refuses_integer_only_batches():
+    """Regression: a step.nan rule that cannot actually poison anything
+    (all-integer token batch) must raise, not silently no-op — a fake
+    chaos pass is worse than a failed one."""
+    reg = faults.parse_spec("step.nan:step=1", seed=0)
+    with pytest.raises(faults.FaultSpecError, match="no floating-point"):
+        reg.poison_batch({"input_ids": np.zeros((4, 8), np.int32),
+                          "mask": np.ones((4, 8), np.int32)}, step=1)
+
+
+def test_prefetch_iterator_close_releases_producer():
+    """Regression: an abandoned PrefetchIterator (rollback rebuilds the
+    loader) must release its producer thread, not strand it on a full
+    queue forever."""
+    import itertools
+    import time as _time
+
+    from distributed_tensorflow_example_tpu.data.loader import (
+        PrefetchIterator)
+    it = PrefetchIterator(iter(itertools.count()), depth=1)
+    assert next(it) == 0
+    it.close()
+    deadline = _time.time() + 5.0
+    while it._thread.is_alive() and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert not it._thread.is_alive(), "producer thread leaked past close()"
+
+
+def test_disabled_log_cadence_adds_no_policy_syncs_under_halt():
+    """A run that tuned host syncs off (log_every_steps=0) must not gain
+    a 100-step materialization from the default halt policy; an explicit
+    skip policy IS a request for active healing and gets the fallback."""
+    t = _trainer(_cfg(log_every=0))
+    with t:
+        assert not [h for h in t.hooks
+                    if isinstance(h, hooks_lib.AnomalyPolicyHook)]
+    t2 = _trainer(_cfg(on_anomaly="skip", log_every=0))
+    with t2:
+        hooks = [h for h in t2.hooks
+                 if isinstance(h, hooks_lib.AnomalyPolicyHook)]
+        assert len(hooks) == 1 and hooks[0].every_steps == 100
+
+
+def test_rollback_discards_rejected_trajectory_checkpoints(tmp_path):
+    """Regression: checkpoints saved AFTER the rollback target embed the
+    skipped-update window; they must be evicted so a preemption during
+    the replay cannot resume the rejected trajectory."""
+    ck = str(tmp_path / "ckpt")
+    with _trainer(_cfg(20, ckpt_dir=ck, save_steps=2, log_every=5,
+                       on_anomaly="rollback",
+                       fault_spec="step.nan:step=7")) as t:
+        s, summary = t.train()
+    assert summary["final_step"] == 20
+    from distributed_tensorflow_example_tpu.ckpt.checkpoint import \
+        CheckpointManager
+    # replay re-saves the later steps; the final ring must be the clean
+    # trajectory (latest = 20) with every step verifiable
+    mgr = CheckpointManager(ck)
+    assert mgr.latest_step() == 20
+    assert mgr.latest_valid_step() == 20

@@ -235,3 +235,64 @@ def test_cli_fast_decode_guards():
         main(["--model=resnet50", "--fast_decode", "--train_steps=1"])
     with pytest.raises(SystemExit, match="JPEG"):
         main(["--model=mlp", "--fast_decode", "--train_steps=1"])
+
+
+def _bad_image_tree(tmp_path, n_good=7, n_bad=1):
+    """One class of 64x64 PNGs with ``n_bad`` undecodable files mixed in."""
+    from PIL import Image
+    rs = np.random.RandomState(1)
+    root = tmp_path / "train" / "class_0"
+    root.mkdir(parents=True)
+    for i in range(n_good):
+        Image.fromarray(rs.randint(0, 255, (64, 64, 3),
+                                   dtype=np.uint8)).save(root / f"g{i}.png")
+    for i in range(n_bad):
+        (root / f"z_bad{i}.png").write_bytes(b"not an image at all")
+    return str(tmp_path)
+
+
+def test_bad_image_skipped_and_slot_refilled(tmp_path, monkeypatch):
+    """A truncated/garbage image is skipped (after the bounded IO retry)
+    and its batch slot refilled from a neighbor — run-killing exception
+    becomes a logged count."""
+    from distributed_tensorflow_example_tpu.runtime import faults
+    monkeypatch.setattr(faults, "RETRY_BASE_DELAY", 0.001)
+    tree = _bad_image_tree(tmp_path)
+    f = StreamingImageFolder(tree, "train", image_size=32, global_batch=8,
+                             shuffle=False, seed=0)
+    try:
+        batch = next(f.epoch_batches(0))
+        assert batch["x"].shape == (8, 32, 32, 3)   # full static batch
+        assert batch["y"].shape == (8,)
+        assert f._skip["total"] == 1
+        # the refill slot duplicates a good neighbor, not garbage
+        assert np.isfinite(batch["x"]).all()
+    finally:
+        f.close()
+
+
+def test_bad_image_cap_per_epoch_raises(tmp_path, monkeypatch):
+    from distributed_tensorflow_example_tpu.runtime import faults
+    monkeypatch.setattr(faults, "RETRY_BASE_DELAY", 0.001)
+    tree = _bad_image_tree(tmp_path, n_good=6, n_bad=2)
+    f = StreamingImageFolder(tree, "train", image_size=32, global_batch=8,
+                             shuffle=False, seed=0,
+                             max_skipped_per_epoch=1)
+    try:
+        with pytest.raises(RuntimeError, match="cap"):
+            next(f.epoch_batches(0))
+    finally:
+        f.close()
+
+
+def test_all_bad_batch_refuses_to_fabricate(tmp_path, monkeypatch):
+    from distributed_tensorflow_example_tpu.runtime import faults
+    monkeypatch.setattr(faults, "RETRY_BASE_DELAY", 0.001)
+    tree = _bad_image_tree(tmp_path, n_good=0, n_bad=8)
+    f = StreamingImageFolder(tree, "train", image_size=32, global_batch=8,
+                             shuffle=False, seed=0)
+    try:
+        with pytest.raises(RuntimeError, match="every sample"):
+            next(f.epoch_batches(0))
+    finally:
+        f.close()

@@ -1,0 +1,22 @@
+"""Two-process checkpoint-corruption fallback: the multi-host broadcast
+path of the verified-checkpoint story (``_agreed_latest_step``).
+
+The worker (_two_process_corrupt_worker.py) saves two checkpoints on a
+shared directory, corrupts the latest on the chief, and asserts BOTH
+processes broadcast-agree on the fallback step and restore it — for the
+single-file format and for the sharded format with a deleted shard.
+"""
+
+import os
+
+import pytest
+
+from _cluster_harness import run_two_process
+
+pytestmark = pytest.mark.slow      # real two-process cluster spawn
+
+
+def test_corrupt_fallback_agrees_across_processes(tmp_path):
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_two_process_corrupt_worker.py")
+    run_two_process(worker, args=(str(tmp_path),), timeout=600)
