@@ -457,6 +457,41 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     return row
 
 
+def _run_serving(*, clients: int, requests: int, prompt_len: int,
+                 max_new: int, slots: int, tiny: bool) -> dict:
+    """The continuous-batching serving row: closed-loop clients against
+    the in-process REST server with the scheduler ON (the
+    experiments/serving_load.py harness). Published as
+    ``{key}_serving_tps`` / ``{key}_serving_p95_ms`` so the next TPU
+    window baselines the serving path, plus the dispatch counters the
+    continuous-batching invariant is judged by (decode dispatches ~
+    max per-request length per wave, not the per-request sum)."""
+    import tempfile
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "experiments"))
+    import serving_load
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    with tempfile.TemporaryDirectory() as d:
+        vocab = serving_load.build_export(
+            d, prompt_len=prompt_len, max_new=max_new, slots=slots,
+            model_name="gpt_tiny" if tiny else "gpt",
+            platforms=("cpu", "tpu") if on_tpu else ("cpu",))
+        matrix = serving_load.make_requests(
+            clients, requests, prompt_len=prompt_len, max_new=max_new,
+            vocab=vocab, seed=0)
+        row = serving_load.run_mode(d, matrix, scheduler="on",
+                                    prompt_len=prompt_len)
+    return {
+        "serving_tps": row["tokens_per_s"],
+        "serving_p95_ms": row["latency_p95_ms"],
+        "serving_decode_steps": row["decode_steps"],
+        "serving_steps_shared": row["steps_shared"],
+        "serving_errors": len(row["errors"]),
+    }
+
+
 def _long_batch(model, batch, i):
     """BERT batch at the model's FULL configured sequence length
     (dummy_batch caps at 128 for the seq-128 workloads)."""
@@ -580,6 +615,15 @@ def _workloads(on_tpu: bool, scale: int) -> "list[dict]":
                          # isolates the device component from the
                          # ~100 ms/call tunnel overhead
                          amortize_new=512 if on_tpu else 32)),
+        # continuous-batching serving row (round 9): closed-loop
+        # clients through the scheduler-on REST server — throughput +
+        # p95 latency + the shared-dispatch counters, baselined at the
+        # next TPU window (BASELINE.md "Serving")
+        dict(key="gpt", only={"serving", "gpt_serving"},
+             serving=dict(clients=8, requests=4 if on_tpu else 2,
+                          prompt_len=128 if on_tpu else 16,
+                          max_new=64 if on_tpu else 8,
+                          slots=8, tiny=not on_tpu)),
     ]
 
 
@@ -649,6 +693,11 @@ def main() -> None:
         if only is not None and not (w["only"] & set(only)):
             continue
         key = w["key"]
+        if "serving" in w:
+            row = _run_serving(**w["serving"])
+            for k, v in row.items():
+                extra[f"{key}_{k}"] = v
+            continue
         if "decode" in w:
             row = _run_decode(**w["decode"])
             extra[f"{key}_tokens_s_chip"] = round(row["tokens_s_chip"])

@@ -564,6 +564,115 @@ class GPT:
         return (self.lm_logits(params, h[:, None])[:, 0],
                 {"k": ks, "v": vs})
 
+    def ragged_prefill(self, params, input_ids, prompt_mask,
+                       total_len: int):
+        """Ragged-prompt prefill: right-pack every row's real tokens
+        against slot S0-1 (stable argsort — order preserving), build
+        per-row positions/attention from the pad count, and run
+        :meth:`_prefill` padded to ``total_len`` cache slots. Returns
+        ``(last_hidden [B, hid], caches, pad [B])``. ONE body for
+        :meth:`generate`'s ragged branch and the stepwise serving
+        export (serving.export_generator ``stepwise=True``) — the
+        continuous-batching engine's admission prefill is the exact
+        computation the monolithic path runs."""
+        b, s0 = input_ids.shape
+        # normalize to 0/1 first: the docstring contract is "nonzero
+        # = real token", and a 2 in the mask would otherwise corrupt
+        # the pad count below (and disagree with the HTTP server's
+        # `!= 0` validation)
+        pm = (jnp.asarray(prompt_mask) != 0).astype(jnp.int32)
+        # stable argsort keys pads (0) first, real tokens (1) after
+        # IN ORDER: one gather right-packs every row
+        order = jnp.argsort(pm, axis=1, stable=True)
+        ids = jnp.take_along_axis(jnp.asarray(input_ids), order, axis=1)
+        pad = (s0 - jnp.sum(pm, axis=1)).astype(jnp.int32)
+        valid = jnp.arange(s0, dtype=jnp.int32)[None, :] >= pad[:, None]
+        ids = jnp.where(valid, ids, 0)
+        pos_ids = jnp.maximum(
+            jnp.arange(s0, dtype=jnp.int32)[None, :] - pad[:, None], 0)
+        last_h, caches = self._prefill(params, ids, total_len,
+                                       mask=valid.astype(jnp.int32),
+                                       pos_ids=pos_ids)
+        return last_h, caches, pad
+
+    def decode_step_batched(self, params, stacked, caches, tok, pos,
+                            pad, alive=None,
+                            decode_attention: str | None = None):
+        """One-token forward with PER-ROW cache depths — the decode
+        step of the continuous-batching serving engine, where slots
+        were admitted at different times and therefore sit at
+        different positions in their own sequences.
+
+        Same fast-path body as :meth:`_decode_step_stacked` (one
+        ``lax.scan`` over the stacked layer axis, fused QKV, 2-D
+        residual stream) with two generalizations:
+
+        - ``pos`` is [B] int32 (row b's token writes cache slot
+          ``pos[b]`` and carries position id ``pos[b] - pad[b]``)
+          instead of one shared scalar;
+        - ``alive`` [B] (bool / 0-1) gates the cache write: a retired
+          slot's slab keeps its old bytes (its lane still computes —
+          wasted work the shared step accepts — but cannot mutate the
+          pool; admission prefill overwrites the whole slab anyway).
+
+        Rows are independent: row b's logits depend only on row b's
+        token/pos/pad/cache, which is what makes the engine's shared
+        step produce the same token stream per request as a
+        single-request run (tier-1 tested). ``caches``:
+        ``{"k": [L, B, T, H, D], "v": [L, B, T, H, D]}``.
+        """
+        from ..ops.pallas.decode_attention import (decode_attention as
+                                                   decode_attn)
+        c = self.cfg
+        b = tok.shape[0]
+        total = caches["k"].shape[2]
+        impl = decode_attention or self.decode_attention_impl
+        pos = jnp.clip(jnp.asarray(pos, jnp.int32), 0, total - 1)
+        pad = jnp.asarray(pad, jnp.int32)
+        if alive is None:
+            alive = jnp.ones((b,), bool)
+        alive = jnp.asarray(alive) != 0
+        # dead rows may carry stale pos/pad; clamp the position id so
+        # the wpe lookup stays in range (live rows are unaffected —
+        # their pos - pad is a real position by construction)
+        pos_ids = jnp.clip(pos - pad, 0, c.max_len - 1)
+        h, _ = self._embed(params, tok[:, None], pos_ids[:, None],
+                           rng=None, train=False)
+        h = h[:, 0]                                       # [B, hid]
+        rows = jnp.arange(b)
+
+        def body(h, xs):
+            lp, ck, cv = xs
+            qkv = nn.dense(self._dequant(lp["qkv"]),
+                           nn.layernorm(lp["ln1"], h), dtype=self.dtype)
+            q, k, v = [x.reshape(b, c.heads, self.head_dim)
+                       for x in jnp.split(qkv, 3, axis=-1)]
+            # per-row scatter at each row's own depth; dead rows
+            # rewrite their old bytes (no-op write keeps the pool
+            # stable for retired slots)
+            k_w = jnp.where(alive[:, None, None],
+                            k.astype(ck.dtype), ck[rows, pos])
+            v_w = jnp.where(alive[:, None, None],
+                            v.astype(cv.dtype), cv[rows, pos])
+            ck = ck.at[rows, pos].set(k_w)
+            cv = cv.at[rows, pos].set(v_w)
+            ctx = decode_attn(q, ck, cv, pos=pos, pad=pad, impl=impl)
+            a = nn.dense(self._dequant(lp["o"]), ctx.reshape(b, c.hidden),
+                         dtype=self.dtype)
+            h = h + a.astype(h.dtype)
+            f = nn.dense(self._dequant(lp["ffn_in"]),
+                         nn.layernorm(lp["ln2"], h), dtype=self.dtype)
+            f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+            f = nn.dense(self._dequant(lp["ffn_out"]), f, dtype=self.dtype)
+            h = h + f.astype(h.dtype)
+            return h, (ck, cv)
+
+        h, (ks, vs) = lax.scan(body, h,
+                               (stacked, caches["k"], caches["v"]))
+        h = nn.layernorm(params["ln_f"], h)
+        return (self.lm_logits(params, h[:, None])[:, 0],
+                {"k": ks, "v": vs})
+
     def _stack_caches(self, caches):
         """Per-layer {layer_i: {k, v}} prefill caches -> the stacked
         {"k": [L, ...], "v": [L, ...]} slabs the scan step consumes."""
@@ -700,24 +809,8 @@ class GPT:
                 raise ValueError(
                     f"prompt_mask shape {tuple(prompt_mask.shape)} != "
                     f"input_ids shape {(b, s0)}")
-            # normalize to 0/1 first: the docstring contract is "nonzero
-            # = real token", and a 2 in the mask would otherwise corrupt
-            # the pad count below (and disagree with the HTTP server's
-            # `!= 0` validation)
-            pm = (jnp.asarray(prompt_mask) != 0).astype(jnp.int32)
-            # stable argsort keys pads (0) first, real tokens (1) after
-            # IN ORDER: one gather right-packs every row
-            order = jnp.argsort(pm, axis=1, stable=True)
-            ids = jnp.take_along_axis(jnp.asarray(input_ids), order,
-                                      axis=1)
-            pad = (s0 - jnp.sum(pm, axis=1)).astype(jnp.int32)
-            valid = jnp.arange(s0, dtype=jnp.int32)[None, :] >= pad[:, None]
-            ids = jnp.where(valid, ids, 0)
-            pos_ids = jnp.maximum(
-                jnp.arange(s0, dtype=jnp.int32)[None, :] - pad[:, None], 0)
-            last_h, caches = self._prefill(params, ids, total,
-                                           mask=valid.astype(jnp.int32),
-                                           pos_ids=pos_ids)
+            last_h, caches, pad = self.ragged_prefill(
+                params, input_ids, prompt_mask, total)
         else:
             pad = jnp.zeros((b,), jnp.int32)
             last_h, caches = self._prefill(params, input_ids, total)

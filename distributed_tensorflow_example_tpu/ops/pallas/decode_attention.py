@@ -64,9 +64,11 @@ def _kernel(pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref, *,
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * sm_scale
     # ragged pad/pos mask fused in: slot j of row b is live iff
-    # pad_b <= j <= pos (pos = the slot the current token sits at)
+    # pad_b <= j <= pos_b (pos_b = the slot row b's current token sits
+    # at — per-row since the continuous-batching engine, where slots
+    # admitted at different times sit at different depths)
     kpos = lax.broadcasted_iota(jnp.int32, (1, total), 1)
-    live = (kpos <= pos_ref[0]) & (kpos >= pad_ref[b])
+    live = (kpos <= pos_ref[b]) & (kpos >= pad_ref[b])
     s = jnp.where(live, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)                                  # masked -> exact 0
@@ -95,7 +97,7 @@ def _dispatch(q, k, v, pos, pad):
         functools.partial(_kernel, total=t, sm_scale=1.0 / math.sqrt(d)),
         grid=(b, h),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),               # pos [1]
+            pl.BlockSpec(memory_space=pltpu.SMEM),               # pos [B]
             pl.BlockSpec(memory_space=pltpu.SMEM),               # pad [B]
             pl.BlockSpec((1, 1, d), lambda bb, hh: (bb * h + hh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bb, hh: (bb, 0, hh)),
@@ -118,7 +120,9 @@ def xla_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     and the fallback for tile-unfriendly shapes."""
     total = k.shape[1]
     slots = jnp.arange(total, dtype=jnp.int32)
-    live = (slots[None, :] <= pos) & (slots[None, :] >= pad[:, None])
+    pos = jnp.asarray(pos, jnp.int32)
+    pos_b = pos[:, None] if pos.ndim == 1 else pos
+    live = (slots[None, :] <= pos_b) & (slots[None, :] >= pad[:, None])
     ctx = multi_head_attention(q[:, None], k, v,
                                mask=live[:, None, None, :], impl="xla")
     return ctx[:, 0]
@@ -130,8 +134,11 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     ``q``: [B, H, D] (the current token's heads); ``k``/``v``:
     [B, T, H, D] cache slabs (slot ``pos`` already written); ``pos``:
-    scalar int32 cache slot of the current token; ``pad``: [B] int32
-    per-row dead-slot count (ragged prompts). Returns [B, H, D] context.
+    int32 cache slot of the current token — a scalar (one shared decode
+    depth, the ``generate`` loop) or a [B] vector (per-row depths, the
+    continuous-batching engine where slots join mid-flight); ``pad``:
+    [B] int32 per-row dead-slot count (ragged prompts). Returns
+    [B, H, D] context.
 
     ``impl``: ``"auto"`` takes the kernel on TPU when
     :func:`tile_friendly` holds and the XLA path otherwise; ``"pallas"``
@@ -154,5 +161,7 @@ def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             "for the XLA fallback)")
     if not use_kernel:
         return xla_decode_attention(q, k, v, pos=pos, pad=pad)
-    pos1 = jnp.asarray(pos, jnp.int32).reshape((1,))
-    return _dispatch(q, k, v, pos1, pad.astype(jnp.int32))
+    # kernel reads one pos per row from SMEM; broadcast a scalar pos
+    posb = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    return _dispatch(q, k, v, posb, pad.astype(jnp.int32))

@@ -36,6 +36,11 @@ _LABEL_KEYS = ("y", "masked_labels", "masked_weights", "__valid__")
 
 _ARTIFACT = "model.stablehlo"
 _META = "export.json"
+# stepwise-generator artifacts (export_generator stepwise=True): the
+# prefill and shared-decode-step programs the continuous-batching
+# engine (serving_batch.py) drives, beside the monolithic artifact
+_PREFILL = "prefill.stablehlo"
+_DECODE = "decode.stablehlo"
 
 
 def serving_signature(batch: dict[str, Any]) -> dict[str, Any]:
@@ -153,6 +158,7 @@ def export_generator(model, params, out_dir: str, *,
                      ragged: bool = False,
                      decode_impl: str = "stacked",
                      tokens_per_dispatch: int = 1,
+                     stepwise: bool = False, slots: int = 8,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Serialize ``model.generate`` (params baked; greedy or
     temperature/top-k/top-p sampling, optional EOS early-stop) as a
@@ -175,7 +181,30 @@ def export_generator(model, params, out_dir: str, *,
     (kernel-capable) setting. When sampling, the serve-time PRNG
     contract is recorded as ``prng_impl`` so the HTTP server
     synthesizes ``rng`` key data with the impl the program was traced
-    under."""
+    under.
+
+    ``stepwise=True`` additionally exports the TWO programs a
+    continuous-batching scheduler (serving_batch.py) needs, beside the
+    monolithic artifact:
+
+    - ``prefill.stablehlo`` — one prompt ([1, prompt_len] ids + mask,
+      the ragged right-pack contract) plus the whole cache pool and a
+      ``slot`` index → first-token logits, the row's pad count, and
+      the pool with that slot's [T, H, D] per-layer K/V slab written
+      (the full slab is overwritten, so slot reuse needs no cleanup).
+    - ``decode.stablehlo`` — ONE shared decode step for every slot:
+      per-slot token/pos/pad/alive + pool → next-token logits [slots,
+      vocab] + updated pool, riding the stacked-scan fast path with
+      PER-ROW cache depths (``GPT.decode_step_batched``).
+
+    Sampling under the scheduler is host-side per request, so the
+    stepwise programs return logits (no baked temperature/rng); the
+    artifact's own ``temperature``/``top_k``/``top_p``/``eos_id``
+    become the scheduler's per-request DEFAULTS, and ``prng_impl`` is
+    recorded for the host-side per-request keys. Slot count, prompt
+    capacity, and max context are recorded under the ``stepwise``
+    metadata key (static shapes — the pool is the program's working
+    set, sized at export time)."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
 
@@ -211,21 +240,105 @@ def export_generator(model, params, out_dir: str, *,
         jax.jit(serve), platforms=list(platforms))(specs)
 
     extra_meta = {}
-    if sampled:
+    if sampled or stepwise:
         # the serve-time rng contract: key data synthesized with any
         # OTHER default impl has a different shape/meaning and would
         # surface as an opaque executable error (ADVICE r5) — record
-        # the impl the trace consumed so serving_http can honor it
+        # the impl the trace consumed so serving_http can honor it.
+        # Stepwise artifacts record it unconditionally: the scheduler
+        # samples host-side with per-request keys under this impl.
         extra_meta["prng_impl"] = str(
             jax.random.key_impl(jax.random.key(0)))
+    if stepwise:
+        extra_meta["stepwise"] = _export_stepwise(
+            model, params, out_dir, prompt_len=prompt_len,
+            max_new_tokens=max_new_tokens, slots=slots,
+            decode_attention=decode_attention, platforms=platforms)
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
+                           prompt_len=prompt_len,
                            max_new_tokens=max_new_tokens,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p, eos_id=eos_id, pad_id=pad_id,
                            ragged=ragged, decode_impl=decode_impl,
                            tokens_per_dispatch=tokens_per_dispatch,
                            **extra_meta)
+
+
+def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
+                     max_new_tokens: int, slots: int,
+                     decode_attention: str | None,
+                     platforms: Sequence[str]) -> dict:
+    """Trace + serialize the prefill and shared-decode-step programs
+    (see :func:`export_generator` ``stepwise=True``); returns the
+    ``stepwise`` metadata block. Params are already host-gathered."""
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    c = model.cfg
+    total = prompt_len + max_new_tokens
+    if total > c.max_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_len {c.max_len}")
+    head_dim = c.hidden // c.heads
+    cache_dtype = np.dtype(jnp.dtype(model.dtype))
+    pool_shape = (c.layers, slots, total, c.heads, head_dim)
+
+    def prefill_fn(feats):
+        last_h, caches, pad = model.ragged_prefill(
+            params, feats["input_ids"], feats["prompt_mask"], total)
+        kv = model._stack_caches(caches)        # {"k"/"v": [L,1,T,H,D]}
+        slot = feats["slot"]
+        ck = jax.lax.dynamic_update_slice(
+            feats["cache_k"], kv["k"].astype(feats["cache_k"].dtype),
+            (0, slot, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            feats["cache_v"], kv["v"].astype(feats["cache_v"].dtype),
+            (0, slot, 0, 0, 0))
+        return {"logits": model.lm_logits(params, last_h[:, None])[:, 0],
+                "pad": pad, "cache_k": ck, "cache_v": cv}
+
+    stacked = model.stack_decode_params(params)
+
+    def decode_fn(feats):
+        logits, new = model.decode_step_batched(
+            params, stacked,
+            {"k": feats["cache_k"], "v": feats["cache_v"]},
+            feats["tok"], feats["pos"], feats["pad"], feats["alive"],
+            decode_attention=decode_attention)
+        return {"logits": logits, "cache_k": new["k"],
+                "cache_v": new["v"]}
+
+    pool_specs = {
+        "cache_k": jax.ShapeDtypeStruct(pool_shape, cache_dtype),
+        "cache_v": jax.ShapeDtypeStruct(pool_shape, cache_dtype)}
+    prefill_specs = {
+        "input_ids": jax.ShapeDtypeStruct((1, prompt_len), np.int32),
+        "prompt_mask": jax.ShapeDtypeStruct((1, prompt_len), np.int32),
+        "slot": jax.ShapeDtypeStruct((), np.int32), **pool_specs}
+    decode_specs = {
+        "tok": jax.ShapeDtypeStruct((slots,), np.int32),
+        "pos": jax.ShapeDtypeStruct((slots,), np.int32),
+        "pad": jax.ShapeDtypeStruct((slots,), np.int32),
+        "alive": jax.ShapeDtypeStruct((slots,), np.int32), **pool_specs}
+    prefill_exp = jax_export.export(
+        jax.jit(prefill_fn), platforms=list(platforms))(prefill_specs)
+    decode_exp = jax_export.export(
+        jax.jit(decode_fn), platforms=list(platforms))(decode_specs)
+    if jax.process_index() == 0:
+        os.makedirs(out_dir, exist_ok=True)
+        for name, exp in ((_PREFILL, prefill_exp), (_DECODE, decode_exp)):
+            with open(os.path.join(out_dir, name), "wb") as f:
+                f.write(exp.serialize())
+    return {
+        "slots": slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "max_context": total,
+        "pool_shape": list(pool_shape),
+        "cache_dtype": str(cache_dtype),
+        "vocab_size": c.vocab_size,
+    }
 
 
 class ServableModel:
@@ -251,3 +364,78 @@ class ServableModel:
 
 def load_servable(directory: str) -> ServableModel:
     return ServableModel(directory)
+
+
+def has_stepwise(directory: str) -> bool:
+    """True when ``directory`` holds the stepwise (prefill + shared
+    decode step) artifacts a continuous-batching scheduler can drive."""
+    return (os.path.exists(os.path.join(directory, _PREFILL))
+            and os.path.exists(os.path.join(directory, _DECODE)))
+
+
+class StepwiseGenerator:
+    """A loaded stepwise generator export: the prefill and shared
+    decode-step programs plus their metadata, for the
+    continuous-batching engine (serving_batch.GenerationEngine).
+
+    Like :class:`ServableModel`, runs the deserialized StableHLO only —
+    the model code is not consulted. The cache pool rides through both
+    calls as jax arrays; both jits DONATE their inputs so the pool is
+    updated in place where the backend supports aliasing (the pool is
+    the only multi-megabyte operand, and the caller always replaces its
+    reference with the returned pool)."""
+
+    def __init__(self, directory: str):
+        with open(os.path.join(directory, _META)) as f:
+            self.meta = json.load(f)
+        step_meta = self.meta.get("stepwise")
+        if not step_meta or not has_stepwise(directory):
+            raise ValueError(
+                f"{directory!r} holds no stepwise generator artifacts — "
+                "re-export with export_generator(..., stepwise=True) "
+                "(or serve it with the scheduler off)")
+        self.step_meta = step_meta
+        with open(os.path.join(directory, _PREFILL), "rb") as f:
+            self._prefill_exp = jax_export.deserialize(f.read())
+        with open(os.path.join(directory, _DECODE), "rb") as f:
+            self._decode_exp = jax_export.deserialize(f.read())
+        # donate ONLY the pool (the multi-megabyte operand): donating
+        # the whole feature dict would warn per-call about the small
+        # int arrays XLA can't alias into the outputs
+        def split(call):
+            def fn(pool, rest):
+                return call({**rest, **pool})
+            return fn
+
+        self._prefill = jax.jit(split(self._prefill_exp.call),
+                                donate_argnums=(0,))
+        self._decode = jax.jit(split(self._decode_exp.call),
+                               donate_argnums=(0,))
+
+    def make_pool(self) -> dict:
+        """A zeroed cache pool of the exported shape (the engine's
+        one-time allocation)."""
+        m = self.step_meta
+        shape = tuple(m["pool_shape"])
+        dtype = np.dtype(m["cache_dtype"])
+        return {"cache_k": jnp.zeros(shape, dtype),
+                "cache_v": jnp.zeros(shape, dtype)}
+
+    @staticmethod
+    def _split(feats: dict) -> tuple[dict, dict]:
+        pool = {k: feats[k] for k in ("cache_k", "cache_v")}
+        rest = {k: v for k, v in feats.items()
+                if k not in ("cache_k", "cache_v")}
+        return pool, rest
+
+    def prefill(self, feats: dict) -> dict:
+        pool, rest = self._split(feats)
+        return self._prefill(pool, rest)
+
+    def decode(self, feats: dict) -> dict:
+        pool, rest = self._split(feats)
+        return self._decode(pool, rest)
+
+
+def load_stepwise(directory: str) -> StepwiseGenerator:
+    return StepwiseGenerator(directory)

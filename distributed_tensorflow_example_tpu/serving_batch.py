@@ -1,0 +1,611 @@
+"""Continuous-batching generation scheduler + predict micro-batcher.
+
+The serving path was one-request-one-program: every ``:generate`` hit
+ran a full exported decode loop, so 8 concurrent users paid 8
+independent generations even though the decode step itself is
+weight-traffic-bound — a *batched* step costs nearly the same as a
+single-row one (BASELINE.md decode roofline). This module is the piece
+that merges traffic:
+
+- :class:`GenerationEngine` — a scheduler thread owning the exported
+  cache pool (``serving.StepwiseGenerator``). Queued requests are
+  admitted into free slots at step boundaries (one prefill call each —
+  prefill joins mid-flight), every iteration runs ONE shared decode
+  step for all live slots, and per-request sampling (greedy /
+  temperature / top-k / top-p with a per-request seed) happens on the
+  host side of the step boundary. A request retires on its own
+  EOS / ``max_new`` without disturbing its neighbors; the freed slot
+  is reusable at the next admission (the admission prefill overwrites
+  the slot's whole cache slab, so no cleanup pass exists).
+- :class:`MicroBatcher` — dynamic micro-batching for ``:predict``:
+  an admission queue drained up to ``batch_max_size`` rows or
+  ``batch_max_wait_ms``, padded to power-of-two bucket shapes so the
+  jitted executable count stays bounded (static-batch artifacts always
+  run at their exported batch).
+
+Parity contract (tier-1 tested): greedy responses under the scheduler
+are byte-identical to the single-request ``--scheduler off`` path —
+rows of the shared step are computationally independent, and the
+stepwise prefill is the exact ragged-prefill program the monolithic
+artifact runs. The sampled path's contract is per-request-seed
+determinism (NOT bitwise parity with a sampled monolithic artifact:
+that artifact folds one request-level key per step, while the
+scheduler draws a per-request host-side Gumbel stream — two different
+RNG streams by construction).
+
+Both schedulers enforce a bounded queue: a full queue raises
+:class:`QueueFullError`, which the HTTP layer maps to 429 +
+``Retry-After`` (replacing silent unbounded threading).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+# the stdlib Future is the right primitive (set_result/set_exception/
+# result(timeout) — TimeoutError has been the builtin alias since 3.8);
+# the repo already leans on concurrent.futures elsewhere (async ckpt
+# writer, streaming decode pool)
+from concurrent.futures import Future
+
+import numpy as np
+
+from .serving import ServableModel, StepwiseGenerator
+
+
+class QueueFullError(Exception):
+    """Admission queue at capacity — the caller should retry later
+    (HTTP maps this to 429 + Retry-After seconds)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+def percentile(samples, q: float) -> float:
+    """Nearest-rank percentile of an unsorted sample list (0 when
+    empty) — the /stats latency figures."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return float(s[idx])
+
+
+def filter_logits_np(logits: np.ndarray, top_k: int,
+                     top_p: float) -> np.ndarray:
+    """Host-side mirror of ``GPT._filter_logits`` (same >=-threshold
+    tie semantics) on one [V] f32 row: everything outside the kept set
+    drops to -inf."""
+    out = logits.astype(np.float64, copy=True)
+    if top_k:
+        kth = np.sort(out)[-top_k]
+        out[out < kth] = -np.inf
+    if top_p > 0.0:
+        sl = np.sort(out)[::-1]
+        e = np.exp(sl - sl[0])
+        probs = e / e.sum()
+        keep = (np.cumsum(probs) - probs) < top_p
+        thresh = sl[keep].min()
+        out[out < thresh] = -np.inf
+    return out
+
+
+@dataclasses.dataclass
+class GenRequest:
+    """One queued ``:generate`` request (per-request sampling knobs —
+    the artifact's baked values are only the defaults)."""
+    prompt: np.ndarray              # [p] int32, 1 <= p <= prompt_len
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    seed: int
+    eos_id: int | None
+    pad_id: int
+    future: Future = dataclasses.field(default_factory=Future)
+    submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
+
+    def sampler(self):
+        """The per-request host RNG stream: a seeded Philox generator,
+        one Gumbel draw vector per emitted token — deterministic given
+        (seed, token index)."""
+        return np.random.Generator(np.random.Philox(key=self.seed))
+
+
+class _Slot:
+    """Scheduler-side state of one live cache-pool row."""
+
+    def __init__(self, req: GenRequest, index: int, pad: int, pos: int,
+                 rng):
+        self.req = req
+        self.index = index
+        self.pad = pad
+        self.pos = pos                  # next cache slot to be written
+        self.rng = rng
+        self.tokens: list[int] = []
+        self.last_tok = 0
+
+
+class GenerationEngine:
+    """The continuous-batching scheduler (see module docstring).
+
+    ``submit`` is thread-safe (called from HTTP handler threads); all
+    executable calls happen on the single scheduler thread, so the
+    engine is also the generate path's single-flight discipline.
+    """
+
+    def __init__(self, stepwise: StepwiseGenerator, *,
+                 max_queue: int = 64):
+        self.sw = stepwise
+        m = stepwise.step_meta
+        self.slots: int = int(m["slots"])
+        self.prompt_len: int = int(m["prompt_len"])
+        self.max_new_cap: int = int(m["max_new_tokens"])
+        meta = stepwise.meta
+        self.defaults = {
+            "temperature": float(meta.get("temperature", 0.0)),
+            "top_k": int(meta.get("top_k", 0)),
+            "top_p": float(meta.get("top_p", 0.0)),
+            "eos_id": meta.get("eos_id"),
+            "pad_id": int(meta.get("pad_id", 0)),
+        }
+        self.max_queue = max_queue
+        self._pool = stepwise.make_pool()
+        self._queue: deque[GenRequest] = deque()
+        self._cond = threading.Condition()
+        self._live: dict[int, _Slot] = {}
+        self._free = list(range(self.slots))[::-1]   # pop() -> slot 0 first
+        self._running = False
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        # the request currently being prefilled (popped from the queue
+        # but not yet live) — the fault handler must fail it too
+        self._admitting: GenRequest | None = None
+        # stats (all mutated under _cond or by the scheduler thread)
+        self.prefills = 0
+        self.decode_steps = 0
+        self.decode_slot_steps = 0      # sum of live rows over steps
+        self.requests_done = 0
+        self.tokens_out = 0
+        self._latencies: deque[float] = deque(maxlen=2048)
+
+    # ---- client side -------------------------------------------------
+    def _make_request(self, prompt, *, max_new: int | None = None,
+                      temperature: float | None = None,
+                      top_k: int | None = None, top_p: float | None = None,
+                      seed: int = 0,
+                      eos_id: int | None = ...) -> GenRequest:
+        """Validate client inputs into a :class:`GenRequest` — every
+        check happens HERE, on the caller's thread, so nothing
+        client-controlled can raise on the scheduler thread (where one
+        bad request would poison every in-flight neighbor)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt needs at least one token")
+        if prompt.size > self.prompt_len:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds this artifact's "
+                f"exported prompt capacity {self.prompt_len} "
+                "(prompt_len in export.json; re-export with a larger "
+                "prompt_len to serve longer prompts)")
+        if max_new is None:
+            max_new = self.max_new_cap
+        if not 1 <= max_new <= self.max_new_cap:
+            raise ValueError(
+                f"max_new {max_new} outside [1, {self.max_new_cap}] "
+                "(max_new_tokens recorded in export.json)")
+        d = self.defaults
+        req = GenRequest(
+            prompt=prompt, max_new=int(max_new),
+            temperature=d["temperature"] if temperature is None
+            else float(temperature),
+            top_k=d["top_k"] if top_k is None else int(top_k),
+            top_p=d["top_p"] if top_p is None else float(top_p),
+            seed=int(seed),
+            eos_id=d["eos_id"] if eos_id is ... else eos_id,
+            pad_id=d["pad_id"])
+        if req.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{req.temperature}")
+        vocab = int(self.sw.step_meta.get("vocab_size", 0))
+        if req.top_k < 0 or (vocab and req.top_k > vocab):
+            raise ValueError(f"top_k must be in [0, vocab_size={vocab}],"
+                             f" got {req.top_k}")
+        if not 0.0 <= req.top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {req.top_p}")
+        if (req.top_k or req.top_p) and req.temperature <= 0.0:
+            raise ValueError(
+                "top_k/top_p shape the SAMPLING distribution; greedy "
+                "decoding (temperature=0) would silently ignore them — "
+                "set temperature > 0")
+        return req
+
+    def _enqueue(self, reqs: list[GenRequest]) -> list[Future]:
+        """Atomic admission: ALL requests fit in the queue or NONE are
+        queued (a multi-row HTTP request must not strand its first
+        rows generating for nobody when row k hits the bound)."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is stopped")
+            if len(self._queue) + len(reqs) > self.max_queue:
+                raise QueueFullError(
+                    f"admission queue full ({len(self._queue)} waiting, "
+                    f"{len(reqs)} requested, bound {self.max_queue})",
+                    retry_after=self._retry_after())
+            # queueing before start() is allowed (tests pre-load the
+            # queue so the first admission wave is deterministic); the
+            # scheduler drains it once the thread runs
+            self._queue.extend(reqs)
+            self._cond.notify_all()
+        return [r.future for r in reqs]
+
+    def submit(self, prompt, **kw) -> Future:
+        """Queue one request; returns its Future. Raises ``ValueError``
+        for invalid client inputs (clear faults naming the limit) and
+        :class:`QueueFullError` when the admission queue is at
+        ``max_queue``."""
+        return self._enqueue([self._make_request(prompt, **kw)])[0]
+
+    def submit_many(self, prompts, **kw) -> list[Future]:
+        """Validate EVERY prompt, then queue all of them atomically —
+        the multi-row request path (row i samples under ``seed + i``
+        so rows stay independent)."""
+        seed = kw.pop("seed", 0)
+        reqs = [self._make_request(p, seed=seed + i, **kw)
+                for i, p in enumerate(prompts)]
+        return self._enqueue(reqs)
+
+    def generate(self, prompt, timeout: float = 300.0, **kw) -> list[int]:
+        """Blocking convenience wrapper: submit + wait."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def _retry_after(self) -> float:
+        """A Retry-After estimate: the time to drain roughly one
+        generation's worth of work per free-slot wave."""
+        lat = percentile(list(self._latencies), 50) or 1.0
+        return max(1.0, round(lat * (1 + len(self._queue) / self.slots), 1))
+
+    # ---- scheduler thread --------------------------------------------
+    def start(self) -> "GenerationEngine":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="generation-engine",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            self._running = False
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # fail whatever never got scheduled — a hung client is worse
+        # than a clear error
+        err = RuntimeError("generation engine stopped")
+        with self._cond:
+            for req in self._queue:
+                req.future.set_exception(err)
+            self._queue.clear()
+            for slot in self._live.values():
+                slot.req.future.set_exception(err)
+            self._live.clear()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while (self._running and not self._queue
+                       and not self._live):
+                    self._cond.wait(timeout=0.5)
+                if not self._running:
+                    return
+            try:
+                self._admit()
+                if self._live:
+                    self._shared_step()
+            except Exception as e:                      # pragma: no cover
+                # an executable fault poisons every in-flight request
+                # (client input cannot raise here — it is fully
+                # validated on the submitter's thread): surface it to
+                # all waiters INCLUDING a request that died mid-admit,
+                # then rebuild the pool — its buffers were donated to
+                # the failed call, so reusing the old reference would
+                # wedge every later dispatch on a deleted array
+                err = RuntimeError(f"scheduler step failed: {e}")
+                with self._cond:
+                    if self._admitting is not None:
+                        self._admitting.future.set_exception(err)
+                        self._admitting = None
+                    for slot in self._live.values():
+                        slot.req.future.set_exception(err)
+                    self._live.clear()
+                    self._free = list(range(self.slots))[::-1]
+                self._pool = self.sw.make_pool()
+
+    def _admit(self) -> None:
+        """Drain the queue into free slots (one prefill each). Runs
+        between shared steps — prefill joins mid-flight."""
+        while True:
+            with self._cond:
+                if not self._queue or not self._free:
+                    return
+                req = self._queue.popleft()
+                index = self._free.pop()
+                self._admitting = req
+            ids = np.zeros((1, self.prompt_len), np.int32)
+            mask = np.zeros((1, self.prompt_len), np.int32)
+            p = req.prompt.size
+            ids[0, :p] = req.prompt
+            mask[0, :p] = 1
+            out = self.sw.prefill({
+                "input_ids": ids, "prompt_mask": mask,
+                "slot": np.int32(index), **self._pool})
+            self._pool = {"cache_k": out["cache_k"],
+                          "cache_v": out["cache_v"]}
+            self.prefills += 1
+            slot = _Slot(req, index, pad=int(np.asarray(out["pad"])[0]),
+                         pos=self.prompt_len, rng=req.sampler())
+            tok = self._pick(slot, np.asarray(out["logits"])[0])
+            self._emit(slot, tok)
+            with self._cond:
+                self._admitting = None
+
+    def _pick(self, slot: _Slot, logits: np.ndarray) -> int:
+        """Per-request sampling on the host side of the step boundary
+        (greedy argmax mirrors the monolithic program's jnp.argmax —
+        first index on ties)."""
+        req = slot.req
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        scaled = filter_logits_np(logits.astype(np.float64)
+                                  / req.temperature,
+                                  req.top_k, req.top_p)
+        g = slot.rng.gumbel(size=scaled.shape)
+        return int(np.argmax(scaled + g))
+
+    def _emit(self, slot: _Slot, tok: int) -> None:
+        """Record one sampled token; retire or keep the slot live."""
+        slot.tokens.append(tok)
+        slot.last_tok = tok
+        self.tokens_out += 1
+        req = slot.req
+        done = (len(slot.tokens) >= req.max_new
+                or (req.eos_id is not None and tok == req.eos_id))
+        if done:
+            # pad to max_new after EOS — byte-identical to the
+            # monolithic while_loop's preallocated pad_id buffer
+            toks = slot.tokens + [req.pad_id] * (req.max_new
+                                                 - len(slot.tokens))
+            self._latencies.append(time.perf_counter() - req.submitted_at)
+            self.requests_done += 1
+            with self._cond:
+                self._free.append(slot.index)
+            req.future.set_result(toks)
+        else:
+            self._live[slot.index] = slot
+
+    def _shared_step(self) -> None:
+        """ONE batched decode step for every live slot."""
+        tok = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        pad = np.zeros((self.slots,), np.int32)
+        alive = np.zeros((self.slots,), np.int32)
+        for i, s in self._live.items():
+            tok[i] = s.last_tok
+            pos[i] = s.pos
+            pad[i] = s.pad
+            alive[i] = 1
+        out = self.sw.decode({"tok": tok, "pos": pos, "pad": pad,
+                              "alive": alive, **self._pool})
+        self._pool = {"cache_k": out["cache_k"],
+                      "cache_v": out["cache_v"]}
+        self.decode_steps += 1
+        self.decode_slot_steps += len(self._live)
+        logits = np.asarray(out["logits"])
+        finished = []
+        for i, s in list(self._live.items()):
+            s.pos += 1
+            nxt = self._pick(s, logits[i])
+            del self._live[i]           # _emit re-adds if still live
+            self._emit(s, nxt)
+
+    # ---- observability ----------------------------------------------
+    def stats(self) -> dict:
+        with self._cond:
+            lat = list(self._latencies)
+            queue_depth = len(self._queue)
+            live = len(self._live)
+        shared = (self.decode_slot_steps / self.decode_steps
+                  if self.decode_steps else 0.0)
+        return {
+            "slots": self.slots,
+            "live_slots": live,
+            "queue_depth": queue_depth,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "decode_slot_steps": self.decode_slot_steps,
+            "steps_shared": round(shared, 3),
+            "requests_done": self.requests_done,
+            "tokens_out": self.tokens_out,
+            "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
+            "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
+            "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),
+        }
+
+
+class MicroBatcher:
+    """Dynamic micro-batching for ``:predict`` requests.
+
+    Handler threads :meth:`submit` feature rows; a single batcher
+    thread gathers up to ``batch_max_size`` rows or
+    ``batch_max_wait_ms`` (whichever first), pads the gathered count
+    up to a power-of-two bucket (repeating the first row — the
+    framework's established pad convention), runs the servable ONCE,
+    and scatters the result rows back to the per-request futures.
+    Bucketing bounds the executable count to log2(batch_max_size)+1
+    shapes; static-batch artifacts always run at their exported batch
+    (their one legal shape).
+    """
+
+    def __init__(self, servable: ServableModel, *,
+                 batch_max_size: int = 8, batch_max_wait_ms: float = 5.0,
+                 max_queue: int = 256):
+        if batch_max_size < 1:
+            raise ValueError(f"batch_max_size must be >= 1, got "
+                             f"{batch_max_size}")
+        if batch_max_wait_ms < 0:
+            raise ValueError(f"batch_max_wait_ms must be >= 0, got "
+                             f"{batch_max_wait_ms}")
+        self.servable = servable
+        self.static_batch = None
+        if not servable.meta.get("batch_polymorphic", True):
+            sig = servable.input_signature
+            self.static_batch = next(iter(sig.values()))["shape"][0]
+            batch_max_size = min(batch_max_size, self.static_batch)
+        self.batch_max_size = batch_max_size
+        self.batch_max_wait_s = batch_max_wait_ms / 1e3
+        self.max_queue = max_queue
+        self._queue: deque[tuple[dict, int, Future, float]] = deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: threading.Thread | None = None
+        # stats
+        self.batches = 0
+        self.rows = 0
+        self.padded_rows = 0
+        self._latencies: deque[float] = deque(maxlen=2048)
+
+    def start(self) -> "MicroBatcher":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="predict-batcher",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        err = RuntimeError("predict batcher stopped")
+        with self._cond:
+            for _, _, fut, _ in self._queue:
+                fut.set_exception(err)
+            self._queue.clear()
+
+    def submit(self, feats: dict[str, np.ndarray], n: int) -> Future:
+        """Queue ``n`` rows of already-validated feature arrays."""
+        fut = Future()
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("batcher is not running")
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(
+                    f"predict queue full ({self.max_queue} requests "
+                    "waiting)", retry_after=1.0)
+            self._queue.append((feats, n, fut, time.perf_counter()))
+            self._cond.notify_all()
+        return fut
+
+    def _gather(self) -> list[tuple[dict, int, Future, float]]:
+        """Admission: the first queued request opens a
+        ``batch_max_wait_ms`` window; whatever arrives inside it (up
+        to ``batch_max_size`` rows) shares the dispatch."""
+        with self._cond:
+            while self._running and not self._queue:
+                self._cond.wait(timeout=0.5)
+            if not self._running:
+                return []
+            deadline = time.monotonic() + self.batch_max_wait_s
+            taken = [self._queue.popleft()]
+            rows = taken[0][1]
+            while rows < self.batch_max_size:
+                if self._queue:
+                    nxt_rows = self._queue[0][1]
+                    if rows + nxt_rows > self.batch_max_size:
+                        break
+                    item = self._queue.popleft()
+                    taken.append(item)
+                    rows += item[1]
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            return taken
+
+    def _bucket(self, n: int) -> int:
+        """Always a power of two (static-batch artifacts: their one
+        legal shape) — even an oversized single request rounds UP, so
+        the executable count stays log-bounded instead of compiling a
+        fresh shape per odd row count."""
+        if self.static_batch is not None:
+            return self.static_batch
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _loop(self) -> None:
+        while True:
+            taken = self._gather()
+            if not taken:
+                with self._cond:
+                    if not self._running:
+                        return
+                continue
+            try:
+                self._run(taken)
+            except Exception as e:
+                for _, _, fut, _ in taken:
+                    fut.set_exception(e)
+
+    def _run(self, taken) -> None:
+        n_total = sum(n for _, n, _, _ in taken)
+        bucket = self._bucket(n_total)
+        keys = taken[0][0].keys()
+        cols = {k: np.concatenate([feats[k] for feats, _, _, _ in taken])
+                for k in keys}
+        if n_total < bucket:
+            cols = {k: np.concatenate(
+                [v, np.repeat(v[:1], bucket - n_total, axis=0)])
+                for k, v in cols.items()}
+        preds = np.asarray(self.servable(cols))
+        self.batches += 1
+        self.rows += n_total
+        self.padded_rows += bucket - n_total
+        now = time.perf_counter()
+        off = 0
+        for feats, n, fut, t0 in taken:
+            fut.set_result(preds[off:off + n])
+            self._latencies.append(now - t0)
+            off += n
+
+    def stats(self) -> dict:
+        with self._cond:
+            lat = list(self._latencies)
+            queue_depth = len(self._queue)
+        return {
+            "queue_depth": queue_depth,
+            "batches": self.batches,
+            "rows": self.rows,
+            "padded_rows": self.padded_rows,
+            "batch_max_size": self.batch_max_size,
+            "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
+            "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
+            "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),
+        }

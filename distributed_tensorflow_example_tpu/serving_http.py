@@ -31,9 +31,33 @@ count; static-batch artifacts (the MoE fallback) serve any count UP TO
 their exported batch — the server pads the request to the exported
 batch (repeating the first instance; routing capacity is per-batch, so
 padding only dilutes it) and truncates the response back to the actual
-count. Above the exported batch is a 400. This is a correctness/parity
-server, not a production QPS story: one worker, synchronous execution —
-the compute path is the same jitted StableHLO the offline servable runs.
+count. Above the exported batch is a 400.
+
+Scheduling (round 9): with ``scheduler="on"`` (the default ``"auto"``
+turns it on when the artifact carries stepwise generator programs),
+requests no longer execute one-per-handler-thread:
+
+- ``:generate`` routes through :class:`~.serving_batch.GenerationEngine`
+  — concurrent requests share batched decode steps over one cache pool
+  (continuous batching); prompts may be SHORTER than the exported
+  prompt capacity (the engine right-packs them), and per-request
+  ``max_new``/``temperature``/``top_k``/``top_p``/``seed`` ride the
+  payload.
+- ``:predict`` routes through :class:`~.serving_batch.MicroBatcher` —
+  dynamic micro-batching up to ``batch_max_size`` rows or
+  ``batch_max_wait_ms``.
+- ``GET /stats`` (also ``/v1/models/<name>/stats``) reports queue
+  depth, live slots, decode-dispatch counters (the steps-shared
+  figure), and latency percentiles.
+- a full admission queue is 429 + ``Retry-After`` — bounded admission
+  replacing silent unbounded threading.
+
+``scheduler="off"`` keeps the one-request-one-program path (now behind
+a single-flight lock — ThreadingHTTPServer handler threads must not
+race the executable) — the parity oracle the scheduler's byte-identical
+greedy contract is tested against, and the right choice for offline
+correctness work where cross-request batching would only add moving
+parts.
 """
 
 from __future__ import annotations
@@ -45,7 +69,9 @@ from typing import Any
 
 import numpy as np
 
-from .serving import ServableModel, load_servable
+from .serving import ServableModel, has_stepwise, load_servable
+from .serving_batch import (GenerationEngine, MicroBatcher,
+                            QueueFullError)
 
 
 class _ServerFault(Exception):
@@ -67,17 +93,55 @@ class PredictServer:
     """
 
     def __init__(self, export_dir: str, *, name: str | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 scheduler: str = "auto", batch_max_size: int = 8,
+                 batch_max_wait_ms: float = 5.0, max_queue: int = 64):
+        if scheduler not in ("auto", "on", "off"):
+            raise ValueError(f"scheduler must be auto/on/off, got "
+                             f"{scheduler!r}")
         self.servable: ServableModel = load_servable(export_dir)
         self.name = name or self.servable.meta.get("model", "model")
+        # the single-flight lock for the direct path: _execute is called
+        # from ThreadingHTTPServer handler threads, and nothing else
+        # serializes the executable (the scheduler paths serialize by
+        # construction — one scheduler thread owns all executable calls)
+        self._exec_lock = threading.Lock()
+        is_gen = self.servable.meta.get("kind") == "generator"
+        stepwise = has_stepwise(export_dir)
+        if scheduler == "auto":
+            # ON exactly when the artifact can be scheduled: stepwise
+            # generator programs for :generate, or a predict artifact
+            # (micro-batching needs nothing extra) stays off by default
+            # to keep the plain server a pure parity tool
+            scheduler = "on" if (is_gen and stepwise) else "off"
+        self.scheduler = scheduler
+        self.engine: GenerationEngine | None = None
+        self.batcher: MicroBatcher | None = None
+        if scheduler == "on":
+            if is_gen:
+                if not stepwise:
+                    raise ValueError(
+                        f"scheduler='on' needs stepwise generator "
+                        f"artifacts in {export_dir!r} — re-export with "
+                        "export_generator(..., stepwise=True), or serve "
+                        "with scheduler='off'")
+                from .serving import load_stepwise
+                self.engine = GenerationEngine(
+                    load_stepwise(export_dir), max_queue=max_queue).start()
+            else:
+                self.batcher = MicroBatcher(
+                    self.servable, batch_max_size=batch_max_size,
+                    batch_max_wait_ms=batch_max_wait_ms,
+                    max_queue=max_queue).start()
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
     # -- request plumbing ----------------------------------------------
-    def _feature_arrays(self, payload: dict,
-                        sig: dict | None = None) -> dict[str, np.ndarray]:
+    def _feature_arrays(self, payload: dict, sig: dict | None = None,
+                        *, pad_static: bool = True
+                        ) -> dict[str, np.ndarray]:
         if sig is None:
             sig = self.servable.input_signature
         if "instances" in payload:
@@ -166,15 +230,22 @@ class PredictServer:
                     f"this artifact was exported with a static batch of "
                     f"{b_exp} instances; got {n} (requests up to {b_exp} "
                     "are padded server-side)")
-            if n < b_exp:
+            if n < b_exp and pad_static:
+                # pad_static=False: the micro-batcher pads AFTER merging
+                # requests — padding here would waste its shared rows
                 out = {k: np.concatenate(
                     [v, np.repeat(v[:1], b_exp - n, axis=0)])
                     for k, v in out.items()}
         return out, n
 
     def _execute(self, feats) -> np.ndarray:
+        # single-flight: handler threads serialize on the executable —
+        # concurrent dispatch of one jitted callable from N threads is
+        # not a contract jax gives us, and "accidentally working" is
+        # not thread safety
         try:
-            return np.asarray(self.servable(feats))
+            with self._exec_lock:
+                return np.asarray(self.servable(feats))
         except Exception as e:
             raise _ServerFault(f"{type(e).__name__}: {e}") from e
 
@@ -182,10 +253,127 @@ class PredictServer:
         if self.servable.meta.get("kind") == "generator":
             raise ValueError(
                 "this artifact is a generator — POST to :generate")
+        if self.batcher is not None:
+            feats, n = self._feature_arrays(payload, pad_static=False)
+            preds = self.batcher.submit(feats, n).result(timeout=300)
+            return {"predictions": np.asarray(preds).tolist()}
         feats, n = self._feature_arrays(payload)
         logits = self._execute(feats)
         # truncate any server-side padding back to the client's count
         return {"predictions": logits[:n].tolist()}
+
+    def _prompt_limit(self) -> int | None:
+        """The exported prompt capacity (explicit metadata since round
+        9; the input signature's second dim for older artifacts)."""
+        pl = self.servable.meta.get("prompt_len")
+        if pl is not None:
+            return int(pl)
+        spec = self.servable.input_signature.get("input_ids")
+        return int(spec["shape"][1]) if spec else None
+
+    def _check_prompt_lengths(self, payload: dict) -> None:
+        """A prompt longer than the artifact's capacity must be a 400
+        NAMING the limit — without this check it surfaces either as an
+        opaque shape-mismatch message or (ragged JSON rows) as numpy's
+        'setting an array element with a sequence'."""
+        limit = self._prompt_limit()
+        if limit is None:
+            return
+        rows = None
+        if isinstance(payload.get("inputs"), dict):
+            rows = payload["inputs"].get("input_ids")
+        elif isinstance(payload.get("instances"), list):
+            rows = [r.get("input_ids") for r in payload["instances"]
+                    if isinstance(r, dict)]
+        if not isinstance(rows, list):
+            return                     # malformed: canonical checks handle
+        for i, row in enumerate(rows):
+            if isinstance(row, (list, np.ndarray)) and len(row) > limit:
+                raise ValueError(
+                    f"prompt {i} has {len(row)} tokens, which exceeds "
+                    f"this artifact's exported prompt capacity {limit} "
+                    "(prompt_len in export.json; re-export with a "
+                    "larger prompt_len to serve longer prompts)")
+
+    def _generate_scheduled(self, payload: dict) -> dict:
+        """:generate via the continuous-batching engine: each instance
+        row becomes one scheduler request (row i of a multi-row request
+        samples under ``seed + i`` so rows stay independent). Rows may
+        be SHORTER than the exported prompt capacity — the engine
+        right-packs ragged prompts natively — and an all-pad
+        ``prompt_mask`` row is rejected like the direct path."""
+        self._check_prompt_lengths(payload)
+        rows = None
+        if isinstance(payload.get("inputs"), dict):
+            rows = payload["inputs"].get("input_ids")
+            masks = payload["inputs"].get("prompt_mask")
+        elif isinstance(payload.get("instances"), list):
+            inst = payload["instances"]
+            if not all(isinstance(r, dict) for r in inst):
+                raise ValueError("generate instances must be dicts with "
+                                 "'input_ids'")
+            bad_keys = set().union(*[set(r) for r in inst]) \
+                - {"input_ids", "prompt_mask"}
+            if bad_keys:
+                raise ValueError(
+                    f"unknown model inputs {sorted(bad_keys)} (the "
+                    "scheduler takes input_ids and prompt_mask)")
+            rows = [r.get("input_ids") for r in inst]
+            masks = ([r.get("prompt_mask") for r in inst]
+                     if any("prompt_mask" in r for r in inst) else None)
+        else:
+            raise ValueError("request needs 'instances' or 'inputs'")
+        if not isinstance(rows, list) or not rows or any(
+                r is None for r in rows):
+            raise ValueError("generate needs non-empty 'input_ids' rows")
+        if masks is not None and len(masks) != len(rows):
+            raise ValueError("prompt_mask row count != input_ids rows")
+        unknown = (set(payload.get("inputs", {}))
+                   - {"input_ids", "prompt_mask"}
+                   if isinstance(payload.get("inputs"), dict) else set())
+        if unknown:
+            raise ValueError(f"unknown model inputs {sorted(unknown)} "
+                             "(the scheduler takes input_ids and "
+                             "prompt_mask)")
+
+        def knob(name, conv):
+            v = payload.get(name)
+            if v is None:
+                return None
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ValueError(f"{name!r} must be a number, got {v!r}")
+            return conv(v)
+
+        kw = {"max_new": knob("max_new", int),
+              "temperature": knob("temperature", float),
+              "top_k": knob("top_k", int),
+              "top_p": knob("top_p", float)}
+        seed = payload.get("seed", 0)
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            raise ValueError(f"'seed' must be an integer, got {seed!r}")
+        prompts = []
+        for i, row in enumerate(rows):
+            prompt = np.asarray(row, np.int32).reshape(-1)
+            if masks is not None and masks[i] is not None:
+                mask = np.asarray(masks[i]).reshape(-1)
+                if mask.shape != prompt.shape:
+                    raise ValueError(
+                        f"prompt_mask row {i} shape {mask.shape} != "
+                        f"input_ids row shape {prompt.shape}")
+                if not np.any(mask != 0):
+                    raise ValueError("every prompt_mask row needs at "
+                                     "least one real token")
+                prompt = prompt[mask != 0]
+            prompts.append(prompt)
+        # submit_many validates EVERY row before queueing ANY, and the
+        # enqueue is atomic — a 400/429 on row k must not leave rows
+        # 0..k-1 generating for a client that already got an error
+        futures = self.engine.submit_many(prompts, seed=seed, **kw)
+        try:
+            gens = [f.result(timeout=300) for f in futures]
+        except (TimeoutError, RuntimeError) as e:
+            raise _ServerFault(f"{type(e).__name__}: {e}") from e
+        return {"generations": gens}
 
     def generate(self, payload: dict) -> dict:
         """The decode route: ``{"inputs": {"input_ids": [[...]], ...},
@@ -193,11 +381,16 @@ class PredictServer:
         artifact input (present when the artifact samples) is NOT a
         per-instance feature — it is synthesized server-side from the
         request's integer ``seed`` (default 0), so clients never handle
-        raw PRNG key data."""
+        raw PRNG key data. With the scheduler on, the request instead
+        rides the continuous-batching engine (per-request sampling
+        knobs in the payload; see :meth:`_generate_scheduled`)."""
         if self.servable.meta.get("kind") != "generator":
             raise ValueError(
                 "this artifact is not a generator — POST to :predict "
                 "(export with export_generator for a decode artifact)")
+        if self.engine is not None:
+            return self._generate_scheduled(payload)
+        self._check_prompt_lengths(payload)
         sig = {k: v for k, v in self.servable.input_signature.items()
                if k != "rng"}
         feats, n = self._feature_arrays(payload, sig)
@@ -263,11 +456,14 @@ class PredictServer:
             def log_message(self, *a):      # quiet: tests/CLI own stdout
                 pass
 
-            def _send(self, code: int, obj: dict) -> None:
+            def _send(self, code: int, obj: dict,
+                      headers: dict | None = None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -277,6 +473,9 @@ class PredictServer:
                         "version": "1", "state": "AVAILABLE",
                         "status": {"error_code": "OK",
                                    "error_message": ""}}]})
+                elif self.path in ("/stats",
+                                   f"/v1/models/{server.name}/stats"):
+                    self._send(200, server.stats())
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -304,6 +503,12 @@ class PredictServer:
                     return
                 try:
                     self._send(200, route(payload))
+                except QueueFullError as e:
+                    # bounded admission: tell the client WHEN to come
+                    # back instead of silently stacking handler threads
+                    self._send(429, {"error": str(e)},
+                               headers={"Retry-After":
+                                        str(int(e.retry_after + 0.5))})
                 except _ServerFault as e:               # executable died:
                     # platform mismatch, runtime OOM, ... must be a 500,
                     # not a dropped connection or a client-blaming 400
@@ -332,11 +537,29 @@ class PredictServer:
         self._thread.start()
         return self
 
+    def stats(self) -> dict:
+        """The /stats payload: scheduler mode plus per-scheduler
+        counters (the generate block's ``decode_steps`` /
+        ``steps_shared`` are the continuous-batching invariant's
+        observable — K concurrent requests should cost ~max(max_new)
+        decode dispatches, not the per-request sum)."""
+        out: dict[str, Any] = {"model": self.name,
+                               "scheduler": self.scheduler}
+        if self.engine is not None:
+            out["generate"] = self.engine.stats()
+        if self.batcher is not None:
+            out["predict"] = self.batcher.stats()
+        return out
+
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.engine is not None:
+            self.engine.close()
+        if self.batcher is not None:
+            self.batcher.close()
 
     def __enter__(self) -> "PredictServer":
         return self.start()
@@ -354,9 +577,23 @@ def main(argv=None) -> int:
     ap.add_argument("--name", default=None)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8501)
+    ap.add_argument("--scheduler", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="continuous batching / micro-batching (auto = "
+                    "on when the artifact has stepwise generator "
+                    "programs); off = the single-flight parity path")
+    ap.add_argument("--batch_max_size", type=int, default=8,
+                    help=":predict micro-batch row cap")
+    ap.add_argument("--batch_max_wait_ms", type=float, default=5.0,
+                    help=":predict admission window per micro-batch")
+    ap.add_argument("--max_queue", type=int, default=64,
+                    help="admission queue bound (full -> 429)")
     args = ap.parse_args(argv)
     srv = PredictServer(args.export_dir, name=args.name, host=args.host,
-                        port=args.port)
+                        port=args.port, scheduler=args.scheduler,
+                        batch_max_size=args.batch_max_size,
+                        batch_max_wait_ms=args.batch_max_wait_ms,
+                        max_queue=args.max_queue)
     print(f"serving {srv.name!r} on http://{args.host}:{srv.port}"
           f"/v1/models/{srv.name}:predict", flush=True)
     srv.serve()

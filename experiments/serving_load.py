@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the serving path: continuous
+batching (scheduler on) vs one-request-one-program (scheduler off).
+
+Builds a seeded GPT, exports a stepwise generator artifact, starts the
+REST server in-process, and drives it with N closed-loop clients × M
+``:generate`` requests each (every client posts, waits, posts again —
+the classic closed-loop model, so offered load tracks service rate).
+Prompt and ``max_new`` lengths are drawn per request from a seeded RNG
+(mixed lengths — the ragged-admission case the scheduler exists for).
+Each mode's row reports:
+
+- ``tokens_per_s`` / ``requests_per_s`` — wall-clock throughput over
+  the whole matrix;
+- ``latency_p50/p95/p99_ms`` — client-observed per-request latency;
+- ``decode_steps`` / ``prefills`` / ``steps_shared`` — the
+  dispatch-count story from ``/stats`` (scheduler on): K concurrent
+  requests should cost ~max(max_new) shared decode dispatches per
+  wave, NOT the per-request sum. Scheduler off reports
+  ``decode_steps = requests`` (one monolithic decode program each).
+
+The greedy outputs of the two modes are asserted byte-identical per
+request (the parity contract) unless ``--no_parity``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python experiments/serving_load.py --smoke
+    python experiments/serving_load.py --clients 8 --requests 8 \
+        --slots 8 --prompt_len 64 --max_new 64
+
+Prints one JSON line per mode plus a ``summary`` line. ``--smoke`` is
+the tier-1 CPU configuration (2 clients, tiny model); the full matrix
+is registered as a ``slow`` test (tests/test_serving_load.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _post(port, name, verb, payload, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:{verb}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _stats(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+def build_export(out_dir: str, *, prompt_len: int, max_new: int,
+                 slots: int, seed: int = 0, model_name: str = "gpt_tiny",
+                 platforms=("cpu",)):
+    """Seeded GPT stepwise export (ragged monolithic artifact too, so
+    the off path serves the same mixed prompt lengths). ``platforms``
+    includes "tpu" when bench.py runs the serving row on chip."""
+    import jax
+    from distributed_tensorflow_example_tpu.config import TrainConfig
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.serving import export_generator
+
+    model = get_model(model_name, TrainConfig(model=model_name))
+    params = model.init(jax.random.key(seed))
+    export_generator(model, params, out_dir, prompt_len=prompt_len,
+                     max_new_tokens=max_new, batch_size=1, ragged=True,
+                     stepwise=True, slots=slots,
+                     platforms=tuple(platforms))
+    return model.cfg.vocab_size
+
+
+def make_requests(clients: int, requests: int, *, prompt_len: int,
+                  max_new: int, vocab: int, seed: int):
+    """The seeded request matrix: [client][request] -> (prompt ids,
+    max_new). Mixed lengths, identical across modes (same seed)."""
+    rs = np.random.RandomState(seed)
+    matrix = []
+    for _ in range(clients):
+        rows = []
+        for _ in range(requests):
+            p = int(rs.randint(1, prompt_len + 1))
+            m = int(rs.randint(1, max_new + 1))
+            rows.append((rs.randint(0, vocab, (p,)).astype(np.int32), m))
+        matrix.append(rows)
+    return matrix
+
+
+def run_mode(export_dir: str, matrix, *, scheduler: str,
+             prompt_len: int) -> dict:
+    """Drive one server mode with the closed-loop client matrix;
+    returns the result row (and stashes per-request generations under
+    ``_gens`` for the parity check)."""
+    from distributed_tensorflow_example_tpu.serving_http import PredictServer
+
+    clients = len(matrix)
+    lat: list[list[float]] = [[] for _ in range(clients)]
+    gens: list[list[list[int]]] = [[] for _ in range(clients)]
+    errors: list[str] = []
+    with PredictServer(export_dir, scheduler=scheduler) as srv:
+        def client(ci):
+            for prompt, m in matrix[ci]:
+                if scheduler == "on":
+                    payload = {"inputs": {"input_ids": [prompt.tolist()]},
+                               "max_new": m}
+                else:
+                    # the monolithic artifact is static-shape: pad to
+                    # the exported prompt + mask; it always generates
+                    # its exported max_new — truncate client-side so
+                    # both modes compare the same m tokens
+                    ids = np.zeros((prompt_len,), np.int32)
+                    ids[:prompt.size] = prompt
+                    mask = np.zeros((prompt_len,), np.int32)
+                    mask[:prompt.size] = 1
+                    payload = {"inputs": {"input_ids": [ids.tolist()],
+                                          "prompt_mask": [mask.tolist()]}}
+                t0 = time.perf_counter()
+                try:
+                    out = _post(srv.port, srv.name, "generate", payload)
+                except Exception as e:          # noqa: BLE001 — recorded
+                    errors.append(f"client {ci}: {type(e).__name__}: {e}")
+                    return
+                lat[ci].append(time.perf_counter() - t0)
+                gens[ci].append(out["generations"][0][:m])
+
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        stats = _stats(srv.port)
+
+    flat_lat = sorted(x for row in lat for x in row)
+    n_req = len(flat_lat)
+    n_tok = sum(len(g) for row in gens for g in row)
+
+    def pctl(q):
+        if not flat_lat:
+            return 0.0
+        i = min(n_req - 1, int(round(q / 100 * (n_req - 1))))
+        return flat_lat[i] * 1e3
+
+    g = stats.get("generate", {})
+    row = {
+        "mode": f"scheduler_{scheduler}",
+        "clients": clients,
+        "requests": n_req,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(n_tok / wall, 2) if wall else 0.0,
+        "requests_per_s": round(n_req / wall, 3) if wall else 0.0,
+        "latency_p50_ms": round(pctl(50), 2),
+        "latency_p95_ms": round(pctl(95), 2),
+        "latency_p99_ms": round(pctl(99), 2),
+        # off path: every request is one monolithic decode dispatch
+        "decode_steps": g.get("decode_steps", n_req),
+        "prefills": g.get("prefills", n_req),
+        "steps_shared": g.get("steps_shared", 1.0),
+        "_gens": gens,
+    }
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per client (closed loop)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--prompt_len", type=int, default=16)
+    ap.add_argument("--max_new", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 CPU config: 2 clients x 2 requests, "
+                    "tiny shapes")
+    ap.add_argument("--no_parity", action="store_true",
+                    help="skip the on-vs-off byte-identity assertion")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.clients, args.requests = 2, 2
+        args.slots, args.prompt_len, args.max_new = 2, 8, 4
+
+    with tempfile.TemporaryDirectory() as d:
+        vocab = build_export(d, prompt_len=args.prompt_len,
+                             max_new=args.max_new, slots=args.slots,
+                             seed=args.seed)
+        matrix = make_requests(args.clients, args.requests,
+                               prompt_len=args.prompt_len,
+                               max_new=args.max_new, vocab=vocab,
+                               seed=args.seed)
+        rows = [run_mode(d, matrix, scheduler="on",
+                         prompt_len=args.prompt_len),
+                run_mode(d, matrix, scheduler="off",
+                         prompt_len=args.prompt_len)]
+
+    parity = None
+    if not args.no_parity:
+        parity = rows[0]["_gens"] == rows[1]["_gens"]
+    ok = (not rows[0]["errors"] and not rows[1]["errors"]
+          and parity is not False)
+    for row in rows:
+        row.pop("_gens")
+        print(json.dumps(row))
+    on, off = rows
+    print(json.dumps({
+        "summary": True,
+        "ok": ok,
+        "greedy_parity": parity,
+        "speedup_tokens_per_s": round(
+            on["tokens_per_s"] / off["tokens_per_s"], 3)
+        if off["tokens_per_s"] else None,
+        "dispatch_ratio": round(
+            off["decode_steps"] / on["decode_steps"], 3)
+        if on["decode_steps"] else None,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Buffer donation of the compiled train step (sync_replicas donate).
+
+The compiled step takes the whole TrainState and returns the next one;
+without donation XLA must hold BOTH in memory across the dispatch —
+params + optimizer state double-buffered in HBM (at the gate shapes
+that's the difference between ~8.4 GiB peak and not fitting headroom
+for anything else). ``SyncReplicas`` donates argument 0 by default;
+these tests pin that contract via XLA's compiled-memory analysis so a
+refactor that silently drops ``donate_argnums`` becomes a red test,
+not a future OOM on chip.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import (OptimizerConfig,
+                                                       TrainConfig)
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.parallel.mesh import build_mesh
+from distributed_tensorflow_example_tpu.parallel.sync_replicas import (
+    SyncReplicas)
+from distributed_tensorflow_example_tpu.train.optimizers import (
+    make_optimizer)
+
+
+def _setup(donate: bool):
+    cfg = TrainConfig(model="mlp",
+                      optimizer=OptimizerConfig(name="adamw",
+                                                learning_rate=1e-3))
+    model = get_model("mlp", cfg)
+    mesh = build_mesh()
+    sync = SyncReplicas(model.loss, make_optimizer(cfg.optimizer), mesh,
+                        donate=donate)
+    state = sync.init(model.init, seed=0)
+    batch = sync.shard_batch(model.dummy_batch(16))
+    return sync, state, batch
+
+
+def _tree_bytes(tree) -> int:
+    return sum(int(np.dtype(l.dtype).itemsize * np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def test_step_donates_params_and_opt_state():
+    """The compiled step's input/output aliasing must cover at least
+    the params + optimizer state bytes — the double-buffering the
+    donation exists to kill. Verified on the COMPILED executable
+    (memory_analysis), not by reading the jit wrapper's kwargs."""
+    sync, state, batch = _setup(donate=True)
+    compiled = sync.step.lower(state, batch).compile()
+    ma = compiled.memory_analysis()
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0]
+    aliased = int(ma.alias_size_in_bytes)
+    want = _tree_bytes(state.params) + _tree_bytes(state.opt_state)
+    assert aliased >= want, (
+        f"compiled step aliases {aliased} bytes; params+opt_state are "
+        f"{want} — donation is not reaching the executable")
+
+
+def test_donate_false_control_buffers_both_states():
+    """The control: with donation off the executable aliases nothing,
+    so the donated build's memory win is attributable to
+    donate_argnums (and the BASELINE.md peak-delta note has a measured
+    basis)."""
+    sync, state, batch = _setup(donate=False)
+    compiled = sync.step.lower(state, batch).compile()
+    ma = compiled.memory_analysis()
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0]
+    assert int(ma.alias_size_in_bytes) == 0
+
+
+def test_donated_input_state_is_consumed():
+    """Functional evidence on this backend: after a step, the donated
+    input state's buffers are deleted — reading them raises instead of
+    silently aliasing stale memory. (This is why call sites snapshot
+    params before stepping, e.g. tests/test_self_healing.py.)"""
+    sync, state, batch = _setup(donate=True)
+    new_state, _ = sync.step(state, batch)
+    jax.block_until_ready(new_state.params)
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    with pytest.raises(RuntimeError):
+        np.asarray(leaf)
+
+
+def test_multi_step_donates_too():
+    """The K-steps-per-dispatch loop carries the same state through K
+    updates — double-buffering there would cost the same peak as the
+    single step; it must alias as well."""
+    cfg = TrainConfig(model="mlp",
+                      optimizer=OptimizerConfig(name="adamw",
+                                                learning_rate=1e-3))
+    model = get_model("mlp", cfg)
+    sync = SyncReplicas(model.loss, make_optimizer(cfg.optimizer),
+                        build_mesh())
+    state = sync.init(model.init, seed=0)
+    host = model.dummy_batch(16)
+    stacked = {k: np.stack([v, v]) for k, v in host.items()}
+    placed = sync.shard_stacked_batch(stacked)
+    compiled = sync.multi_step.lower(state, placed).compile()
+    ma = compiled.memory_analysis()
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0]
+    want = _tree_bytes(state.params) + _tree_bytes(state.opt_state)
+    assert int(ma.alias_size_in_bytes) >= want

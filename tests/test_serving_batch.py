@@ -1,0 +1,446 @@
+"""Continuous-batching serving engine (serving_batch.py + the stepwise
+export): greedy byte-parity with the single-request path, the
+shared-dispatch invariant, slot reuse, EOS retirement, per-seed sampled
+determinism, bounded admission (429), micro-batched :predict, and the
+single-flight lock on the direct path.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import TrainConfig
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.serving import (export_generator,
+                                                        export_model,
+                                                        has_stepwise,
+                                                        load_stepwise,
+                                                        serving_signature)
+from distributed_tensorflow_example_tpu.serving_batch import (
+    GenerationEngine, MicroBatcher, QueueFullError)
+from distributed_tensorflow_example_tpu.serving_http import PredictServer
+
+PROMPT_LEN = 8
+MAX_NEW = 5
+SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def stepwise_dir(tmp_path_factory):
+    """ONE stepwise export shared module-wide (greedy+ragged monolithic
+    artifact beside the prefill/decode programs; sampling knobs are
+    per-request under the scheduler, so the same export also covers the
+    sampled and EOS tests)."""
+    d = str(tmp_path_factory.mktemp("stepwise"))
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    params = m.init(jax.random.key(0))
+    export_generator(m, params, d, prompt_len=PROMPT_LEN,
+                     max_new_tokens=MAX_NEW, batch_size=1, ragged=True,
+                     stepwise=True, slots=SLOTS, platforms=("cpu",))
+    return d, m, params
+
+
+def _prompts(n, seed=0, lo=1, hi=PROMPT_LEN):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, 1000, (int(rs.randint(lo, hi + 1)),)
+                       ).astype(np.int32) for _ in range(n)]
+
+
+def _oracle(m, params, prompt, max_new=MAX_NEW, **kw):
+    """The single-request reference: the live ragged generate (proven
+    equal to the --scheduler off monolithic artifact by
+    tests/test_serving_http.py)."""
+    ids = np.zeros((1, PROMPT_LEN), np.int32)
+    mask = np.zeros((1, PROMPT_LEN), np.int32)
+    ids[0, :prompt.size] = prompt
+    mask[0, :prompt.size] = 1
+    return np.asarray(m.generate(params, jnp.asarray(ids), max_new,
+                                 prompt_mask=jnp.asarray(mask),
+                                 **kw))[0].tolist()
+
+
+def _post(port, name, verb, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:{verb}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_stepwise_export_layout_and_meta(stepwise_dir):
+    d, _, _ = stepwise_dir
+    assert has_stepwise(d)
+    sw = load_stepwise(d)
+    sm = sw.step_meta
+    assert sm["slots"] == SLOTS
+    assert sm["prompt_len"] == PROMPT_LEN
+    assert sm["max_context"] == PROMPT_LEN + MAX_NEW
+    assert sw.meta["prng_impl"]          # host sampling contract
+    pool = sw.make_pool()
+    assert pool["cache_k"].shape == tuple(sm["pool_shape"])
+
+
+def test_shared_dispatch_invariant_and_parity(stepwise_dir):
+    """K concurrent requests (K <= slots) pre-loaded into the queue are
+    admitted in ONE wave and share decode steps: exactly max_new - 1
+    dispatches TOTAL (not K * (max_new - 1)) — and every token stream
+    is byte-identical to the single-request oracle."""
+    d, m, params = stepwise_dir
+    prompts = _prompts(SLOTS, seed=1)
+    eng = GenerationEngine(load_stepwise(d))
+    futs = [eng.submit(p) for p in prompts]     # queued BEFORE start
+    eng.start()
+    try:
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.close()
+    assert eng.prefills == SLOTS
+    assert eng.decode_steps == MAX_NEW - 1, (
+        f"{SLOTS} concurrent requests cost {eng.decode_steps} decode "
+        f"dispatches; continuous batching promises {MAX_NEW - 1}")
+    assert eng.decode_slot_steps == SLOTS * (MAX_NEW - 1)
+    for p, g in zip(prompts, got):
+        assert g == _oracle(m, params, p)
+
+
+def test_slot_reuse_after_retirement(stepwise_dir):
+    """More requests than slots: retired slots are re-admitted (the
+    prefill overwrites the whole cache slab) and every stream still
+    matches the oracle; total work stays shared."""
+    d, m, params = stepwise_dir
+    n = SLOTS * 2 + 2
+    prompts = _prompts(n, seed=2)
+    # mixed max_new so retirements stagger (mid-batch slot churn)
+    rs = np.random.RandomState(3)
+    max_news = [int(rs.randint(1, MAX_NEW + 1)) for _ in range(n)]
+    eng = GenerationEngine(load_stepwise(d))
+    futs = [eng.submit(p, max_new=mn)
+            for p, mn in zip(prompts, max_news)]
+    eng.start()
+    try:
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.close()
+    assert eng.requests_done == n
+    # shared bound: every admission wave costs <= MAX_NEW - 1 steps +
+    # one per admission stagger; far below the per-request sum
+    assert eng.decode_steps < sum(max(mn - 1, 0) for mn in max_news)
+    for p, mn, g in zip(prompts, max_news, got):
+        assert g == _oracle(m, params, p, max_new=mn)
+
+
+def test_eos_retires_mid_batch(stepwise_dir):
+    """A per-request EOS retires its slot without disturbing neighbors,
+    the response is padded with pad_id after the EOS (the monolithic
+    while_loop contract), and parity holds row-for-row."""
+    d, m, params = stepwise_dir
+    prompts = _prompts(SLOTS, seed=4)
+    # pick each prompt's SECOND greedy token as its eos so rows stop at
+    # different, data-dependent points (some may never hit it)
+    greedy = [_oracle(m, params, p) for p in prompts]
+    eos_ids = [g[1] for g in greedy]
+    eng = GenerationEngine(load_stepwise(d))
+    futs = [eng.submit(p, eos_id=e) for p, e in zip(prompts, eos_ids)]
+    eng.start()
+    try:
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.close()
+    for p, e, g in zip(prompts, eos_ids, got):
+        want = _oracle(m, params, p, eos_id=e)
+        assert g == want
+        assert len(g) == MAX_NEW                  # padded after EOS
+
+
+def test_sampled_determinism_per_seed(stepwise_dir):
+    """The sampled path's contract: per-request seeds make the stream
+    deterministic (same seed -> same tokens, across separate engine
+    instances), independent of what shares the batch."""
+    d, m, params = stepwise_dir
+    prompt = _prompts(1, seed=5)[0]
+
+    def run(seed, extra_load=0):
+        eng = GenerationEngine(load_stepwise(d))
+        futs = [eng.submit(prompt, temperature=1.0, top_p=0.9,
+                           seed=seed)]
+        futs += [eng.submit(p, seed=0)
+                 for p in _prompts(extra_load, seed=6)]
+        eng.start()
+        try:
+            return [f.result(timeout=120) for f in futs][0]
+        finally:
+            eng.close()
+
+    a = run(seed=7)
+    b = run(seed=7, extra_load=2)    # batch composition must not matter
+    c = run(seed=8)
+    assert a == b
+    assert a != c
+
+
+def test_queue_full_raises_and_http_429(stepwise_dir):
+    """Bounded admission: engine-level QueueFullError when the queue is
+    at max_queue, and the HTTP layer maps it to 429 + Retry-After."""
+    d, _, _ = stepwise_dir
+    eng = GenerationEngine(load_stepwise(d), max_queue=3)
+    p = _prompts(1, seed=7)[0]
+    eng.submit(p)
+    eng.submit(p)
+    # atomic multi-row admission: 2 rows don't fit the remaining 1
+    # queue slot — NEITHER may be queued (no orphaned generations)
+    with pytest.raises(QueueFullError):
+        eng.submit_many([p, p])
+    assert len(eng._queue) == 2
+    eng.submit(p)                     # queue now full (engine not started)
+    with pytest.raises(QueueFullError) as e:
+        eng.submit(p)
+    assert e.value.retry_after >= 1.0
+    eng.start()
+    eng.close()
+
+    with PredictServer(d) as srv:
+        assert srv.scheduler == "on"
+
+        def full(*a, **k):
+            raise QueueFullError("admission queue full", retry_after=3.0)
+
+        srv.engine.submit_many = full
+        with pytest.raises(urllib.error.HTTPError) as he:
+            _post(srv.port, srv.name, "generate",
+                  {"inputs": {"input_ids": [p.tolist()]}})
+        assert he.value.code == 429
+        assert he.value.headers["Retry-After"] == "3"
+        assert "queue full" in json.loads(he.value.read())["error"]
+
+
+def test_http_concurrent_greedy_parity_and_stats(stepwise_dir):
+    """The acceptance claim end-to-end: >= 8 concurrent greedy
+    :generate requests through the scheduler are byte-identical to the
+    --scheduler off single-request path, while /stats shows the decode
+    dispatches bounded by ~max_new + admissions, not the per-request
+    sum."""
+    d, _, _ = stepwise_dir
+    n = 8
+    prompts = _prompts(n, seed=8)
+    results: list = [None] * n
+    with PredictServer(d) as srv:
+        assert srv.scheduler == "on"
+
+        def worker(i):
+            results[i] = _post(
+                srv.port, srv.name, "generate",
+                {"inputs": {"input_ids": [prompts[i].tolist()]}}
+            )["generations"][0]
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats") as r:
+            stats = json.loads(r.read())["generate"]
+    assert stats["requests_done"] == n
+    assert stats["prefills"] == n
+    # the shared-step bound: with SLOTS slots, n requests run in
+    # ceil(n / SLOTS) waves of <= MAX_NEW - 1 steps, plus at most one
+    # extra step per admission stagger — always far below the
+    # per-request sum n * (MAX_NEW - 1)
+    per_request_sum = n * (MAX_NEW - 1)
+    waves = -(-n // SLOTS)
+    assert stats["decode_steps"] <= waves * (MAX_NEW - 1) + n
+    assert stats["decode_steps"] < per_request_sum
+    assert stats["steps_shared"] > 1.0
+
+    with PredictServer(d, scheduler="off") as srv:
+        assert srv.engine is None
+        for i, p in enumerate(prompts):
+            ids = np.zeros((PROMPT_LEN,), np.int32)
+            mask = np.zeros((PROMPT_LEN,), np.int32)
+            ids[:p.size] = p
+            mask[:p.size] = 1
+            want = _post(srv.port, srv.name, "generate",
+                         {"inputs": {"input_ids": [ids.tolist()],
+                                     "prompt_mask": [mask.tolist()]}}
+                         )["generations"][0]
+            assert results[i] == want, f"request {i} diverged"
+
+
+def test_scheduled_generate_validation(stepwise_dir):
+    """Scheduler-path client faults are clear 400s: over-limit prompt
+    (naming the limit), over-cap max_new, unknown inputs, bad knobs."""
+    d, _, _ = stepwise_dir
+    with PredictServer(d) as srv:
+        cases = [
+            ({"inputs": {"input_ids": [list(range(PROMPT_LEN + 3))]}},
+             "prompt capacity"),
+            ({"inputs": {"input_ids": [[1, 2]]}, "max_new": MAX_NEW + 1},
+             "max_new"),
+            ({"inputs": {"input_ids": [[1, 2]], "bogus": [[1]]}},
+             "unknown model inputs"),
+            ({"inputs": {"input_ids": [[1, 2]]}, "temperature": "hot"},
+             "temperature"),
+            ({"inputs": {"input_ids": [[1, 2]],
+                         "prompt_mask": [[0, 0]]}}, "real token"),
+            ({"inputs": {"input_ids": [[1, 2]], "top_k": 3}, "seed": 1},
+             "top_k"),
+        ]
+        for payload, needle in cases:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(srv.port, srv.name, "generate", payload)
+            assert e.value.code == 400
+            assert needle in json.loads(e.value.read())["error"]
+
+
+def test_prompt_limit_400_on_direct_path(stepwise_dir):
+    """The --scheduler off path names the exported limit too (the
+    pre-round-9 behavior was an opaque numpy/shape error)."""
+    d, _, _ = stepwise_dir
+    with PredictServer(d, scheduler="off") as srv:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.port, srv.name, "generate",
+                  {"inputs": {"input_ids":
+                              [list(range(PROMPT_LEN + 4))]}})
+        assert e.value.code == 400
+        msg = json.loads(e.value.read())["error"]
+        assert str(PROMPT_LEN) in msg and "capacity" in msg
+
+
+@pytest.fixture(scope="module")
+def predict_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("predict"))
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    export_model(m, params, extras, d, platforms=("cpu",))
+    feats = serving_signature(m.dummy_batch(4))
+    want = np.asarray(m.apply(params, extras, feats, train=False)[0])
+    return d, feats, want
+
+
+def test_micro_batcher_merges_and_pads(predict_dir):
+    """Unit-level: three submits inside one admission window run as ONE
+    bucketed dispatch (rows padded to the power-of-two bucket), and
+    every requester gets exactly its own rows back."""
+    d, feats, want = predict_dir
+    from distributed_tensorflow_example_tpu.serving import load_servable
+    mb = MicroBatcher(load_servable(d), batch_max_size=8,
+                      batch_max_wait_ms=250.0).start()
+    try:
+        x = np.asarray(feats["x"])
+        futs = [mb.submit({"x": x[i:i + 1]}, 1) for i in range(3)]
+        got = [f.result(timeout=60) for f in futs]
+    finally:
+        mb.close()
+    assert mb.batches == 1                       # merged, one dispatch
+    assert mb.rows == 3
+    assert mb.padded_rows == 1                   # bucket 4 = next pow2
+    for i, g in enumerate(got):
+        np.testing.assert_allclose(np.asarray(g)[0], want[i],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_http_predict_micro_batched_parity(predict_dir):
+    """scheduler='on' for a predict artifact routes through the
+    micro-batcher; concurrent posts all come back correct, /stats
+    reports the batcher."""
+    d, feats, want = predict_dir
+    x = np.asarray(feats["x"])
+    n = 6
+    results: list = [None] * n
+    with PredictServer(d, scheduler="on", batch_max_wait_ms=50.0) as srv:
+        assert srv.batcher is not None
+
+        def worker(i):
+            out = _post(srv.port, srv.name, "predict",
+                        {"inputs": {"x": x[i % 3:i % 3 + 1].tolist()}})
+            results[i] = np.asarray(out["predictions"])[0]
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats") as r:
+            stats = json.loads(r.read())
+    assert stats["scheduler"] == "on"
+    assert stats["predict"]["rows"] == n
+    for i in range(n):
+        np.testing.assert_allclose(results[i], want[i % 3],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_predict_single_flight_lock(predict_dir):
+    """Regression for the thread-safety fix: ThreadingHTTPServer
+    handler threads must NEVER enter the executable concurrently on
+    the --scheduler off path — observed via a reentrancy-counting
+    shim around the servable."""
+    d, feats, want = predict_dir
+    x = np.asarray(feats["x"])
+
+    with PredictServer(d, scheduler="off") as srv:
+        inner = srv.servable
+
+        class Guard:
+            meta = inner.meta
+            input_signature = inner.input_signature
+
+            def __init__(self):
+                self.active = 0
+                self.max_active = 0
+                self._lock = threading.Lock()
+
+            def __call__(self, f):
+                with self._lock:
+                    self.active += 1
+                    self.max_active = max(self.max_active, self.active)
+                time.sleep(0.02)      # widen any overlap window
+                out = inner(f)
+                with self._lock:
+                    self.active -= 1
+                return out
+
+        guard = Guard()
+        srv.servable = guard
+        results: list = [None] * 8
+
+        def worker(i):
+            out = _post(srv.port, srv.name, "predict",
+                        {"inputs": {"x": x[:2].tolist()}})
+            results[i] = np.asarray(out["predictions"])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert guard.max_active == 1, (
+            "concurrent :predict posts entered the executable "
+            f"{guard.max_active}-deep — the single-flight lock is gone")
+        for r in results:
+            np.testing.assert_allclose(r, want[:2], rtol=1e-5, atol=1e-5)
+
+
+def test_engine_close_fails_pending(stepwise_dir):
+    """Stopping the engine surfaces a clear error on queued requests
+    instead of hanging their clients."""
+    d, _, _ = stepwise_dir
+    eng = GenerationEngine(load_stepwise(d))
+    fut = eng.submit(_prompts(1, seed=9)[0])
+    eng.close()                       # never started
+    with pytest.raises(RuntimeError, match="stopped"):
+        fut.result(timeout=5)
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.submit(_prompts(1, seed=9)[0])

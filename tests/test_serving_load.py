@@ -1,0 +1,67 @@
+"""Serving load generator (experiments/serving_load.py): the tier-1
+smoke runs the 2-client tiny matrix in-process (scheduler on vs off,
+greedy parity asserted by the harness itself); the full load matrix is
+the slow-lane gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "experiments", "serving_load.py")
+sys.path.insert(0, os.path.join(ROOT, "experiments"))
+
+
+def test_smoke_runs_and_holds_parity(capsys):
+    import serving_load
+    rc = serving_load.main(["--smoke"])
+    out = capsys.readouterr().out
+    rows = [json.loads(l) for l in out.splitlines() if l.startswith("{")]
+    assert rc == 0
+    summary = [r for r in rows if r.get("summary")]
+    assert summary and summary[0]["ok"]
+    assert summary[0]["greedy_parity"] is True
+    modes = {r["mode"]: r for r in rows if "mode" in r}
+    assert set(modes) == {"scheduler_on", "scheduler_off"}
+    on = modes["scheduler_on"]
+    assert on["requests"] == 4 and not on["errors"]
+    assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
+    # the dispatch story reaches the row: shared steps recorded
+    assert on["decode_steps"] <= on["requests"] * 4   # smoke max_new=4
+
+
+def test_bench_serving_row_publishes_keys():
+    """bench.py's serving row must publish the {key}_serving_tps /
+    {key}_serving_p95_ms columns the next TPU window baselines."""
+    import bench
+    row = bench._run_serving(clients=2, requests=1, prompt_len=8,
+                             max_new=4, slots=2, tiny=True)
+    assert row["serving_tps"] > 0
+    assert row["serving_p95_ms"] > 0
+    assert row["serving_errors"] == 0
+    assert row["serving_decode_steps"] >= 1
+
+
+@pytest.mark.slow
+def test_full_load_matrix():
+    """The registered slow gate: a real multi-client matrix in a fresh
+    process (8 closed-loop clients, mixed lengths), parity + no errors
+    + the continuous-batching dispatch win (ratio > 1)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--clients", "8", "--requests", "3",
+         "--slots", "4", "--prompt_len", "12", "--max_new", "8"],
+        env=env, capture_output=True, text=True, timeout=1200, cwd=ROOT)
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, f"no output:\n{out.stdout}\n{out.stderr[-2000:]}"
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = [r for r in rows if r.get("summary")][0]
+    assert summary["ok"] and summary["greedy_parity"] is True
+    assert summary["dispatch_ratio"] > 1.0, (
+        "continuous batching did not share decode steps: "
+        f"{summary}")
