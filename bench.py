@@ -473,22 +473,51 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
     import serving_load
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    platforms = ("cpu", "tpu") if on_tpu else ("cpu",)
+    model_name = "gpt_tiny" if tiny else "gpt"
+    # the shared-prefix workload needs sys_len (a block multiple) < the
+    # prompt capacity WITH suffix room — a block of prompt_len/4 keeps
+    # that true for any prompt_len >= 8 (16 at the CPU config would
+    # leave no suffix room and make_requests rejects it loudly)
+    block_size = 16 if prompt_len >= 32 else max(2, prompt_len // 4)
     with tempfile.TemporaryDirectory() as d:
         vocab = serving_load.build_export(
             d, prompt_len=prompt_len, max_new=max_new, slots=slots,
-            model_name="gpt_tiny" if tiny else "gpt",
-            platforms=("cpu", "tpu") if on_tpu else ("cpu",))
+            model_name=model_name, platforms=platforms)
         matrix = serving_load.make_requests(
             clients, requests, prompt_len=prompt_len, max_new=max_new,
             vocab=vocab, seed=0)
         row = serving_load.run_mode(d, matrix, scheduler="on",
                                     prompt_len=prompt_len)
+    # paged + shared-prefix leg (round 10): same closed-loop matrix
+    # shape but every prompt opens with one seeded system prefix — the
+    # prefix-cache hit rate the next TPU window baselines
+    with tempfile.TemporaryDirectory() as d:
+        serving_load.build_export(
+            d, prompt_len=prompt_len, max_new=max_new, slots=slots,
+            model_name=model_name, platforms=platforms, paged=True,
+            block_size=block_size)
+        shared = serving_load.make_requests(
+            clients, requests, prompt_len=prompt_len, max_new=max_new,
+            vocab=vocab, seed=0, prefix_mode="shared",
+            block_size=block_size)
+        prow = serving_load.run_mode(d, shared, scheduler="on",
+                                     prompt_len=prompt_len,
+                                     mode_name="paged_shared")
+    admissions = (prow["prefix_cache_hits"]
+                  + prow["prefix_cache_misses"])
     return {
         "serving_tps": row["tokens_per_s"],
         "serving_p95_ms": row["latency_p95_ms"],
         "serving_decode_steps": row["decode_steps"],
         "serving_steps_shared": row["steps_shared"],
         "serving_errors": len(row["errors"]),
+        "serving_paged_tps": prow["tokens_per_s"],
+        "serving_prefix_hit_rate": round(
+            prow["prefix_cache_hits"] / admissions, 3)
+        if admissions else 0.0,
+        "serving_prefill_tokens_saved": prow["prefill_tokens_saved"],
+        "serving_paged_errors": len(prow["errors"]),
     }
 
 

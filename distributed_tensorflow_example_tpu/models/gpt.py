@@ -376,13 +376,13 @@ class GPT:
     # ------------------------------------------------------------------
     # autoregressive decoding (static-shape KV cache, one compiled scan)
     # ------------------------------------------------------------------
-    def _prefill(self, params, ids, total_len: int, *, mask=None,
-                 pos_ids=None):
-        """Full causal forward over the (possibly left-padded) prompt,
+    def _prefill_full(self, params, ids, total_len: int, *, mask=None,
+                      pos_ids=None):
+        """Full causal forward over the (possibly padded) prompt,
         additionally returning per-layer K/V padded to ``total_len``
         slots. ``mask``/``pos_ids`` serve the ragged-prompt path: pad
         slots are attention-masked out and real tokens carry their own
-        positions. Returns (last_hidden [B,hid], caches
+        positions. Returns (hidden [B,S,hid] post-ln_f, caches
         {layer_i: {k, v}: [B,T,H,D]})."""
         c = self.cfg
         _, s = ids.shape
@@ -400,6 +400,15 @@ class GPT:
             caches[f"layer_{i}"] = {"k": jnp.pad(k, pad),
                                     "v": jnp.pad(v, pad)}
         h = nn.layernorm(params["ln_f"], h)
+        return h, caches
+
+    def _prefill(self, params, ids, total_len: int, *, mask=None,
+                 pos_ids=None):
+        """:meth:`_prefill_full` sliced to the LAST slot's hidden state
+        — the right-packed contract (every row's prompt ends at slot
+        S0-1) the monolithic ``generate`` path runs on."""
+        h, caches = self._prefill_full(params, ids, total_len, mask=mask,
+                                       pos_ids=pos_ids)
         return h[:, -1], caches
 
     def _decode_step(self, params, caches, tok, pos, pad=None):
@@ -669,6 +678,120 @@ class GPT:
 
         h, (ks, vs) = lax.scan(body, h,
                                (stacked, caches["k"], caches["v"]))
+        h = nn.layernorm(params["ln_f"], h)
+        return (self.lm_logits(params, h[:, None])[:, 0],
+                {"k": ks, "v": vs})
+
+    # ------------------------------------------------------------------
+    # block-paged serving path (round 10): the KV pool is shared
+    # [L, N, block_size, H, D] physical blocks + per-slot block tables
+    # ------------------------------------------------------------------
+    def paged_prefill(self, params, input_ids, prompt_mask, k_pool,
+                      v_pool, table_row):
+        """LEFT-ALIGNED prompt prefill writing WHOLE blocks through a
+        block-table row — the paged serving engine's admission program.
+
+        Unlike :meth:`ragged_prefill` (which right-packs so the
+        monolithic loop can advance one shared scalar slot), the paged
+        layout keeps token i at logical slot i: a shared token prefix
+        then occupies the same leading blocks for every request
+        regardless of total prompt length, which is what makes
+        block-granularity prefix reuse possible at all (right-packing
+        shifts the prefix by the per-request pad count). The engine's
+        decode step has per-row ``pos`` anyway, so nothing needed the
+        shared-scalar trick here.
+
+        ``input_ids``/``prompt_mask``: [1, S0] (mask 1 = real token,
+        left-aligned); ``k_pool``/``v_pool``: [L, N, Bs, H, D];
+        ``table_row``: [ceil(S0 / Bs)] int32 physical block ids (the
+        engine points unused trailing entries at the reserved null
+        block 0 — whole-block writes land there and are never read).
+        Returns ``(logits [1, V] of the last real token, k_pool',
+        v_pool')`` with every prompt-capacity block of this row
+        overwritten."""
+        c = self.cfg
+        _, s0 = input_ids.shape
+        bs = k_pool.shape[2]
+        nb_p = table_row.shape[0]
+        total = nb_p * bs
+        pm = (jnp.asarray(prompt_mask) != 0)
+        ids = jnp.where(pm, jnp.asarray(input_ids), 0)
+        h_full, caches = self._prefill_full(
+            params, ids, total, mask=pm.astype(jnp.int32),
+            pos_ids=jnp.arange(s0, dtype=jnp.int32)[None])
+        p = jnp.sum(pm.astype(jnp.int32))
+        last_h = jnp.take_along_axis(
+            h_full, jnp.maximum(p - 1, 0)[None, None, None], axis=1)[:, 0]
+        kv = self._stack_caches(caches)         # {"k"/"v": [L,1,T,H,D]}
+        l = c.layers
+
+        def scatter(pool, stacked):
+            blocks = stacked[:, 0].reshape(l, nb_p, bs, *stacked.shape[3:])
+            return pool.at[:, table_row].set(blocks.astype(pool.dtype))
+
+        return (self.lm_logits(params, last_h[:, None])[:, 0],
+                scatter(k_pool, kv["k"]), scatter(v_pool, kv["v"]))
+
+    def decode_step_batched_paged(self, params, stacked, pools,
+                                  block_tables, tok, pos, pad,
+                                  alive=None,
+                                  decode_attention: str | None = None):
+        """:meth:`decode_step_batched` with the cache read/written
+        THROUGH per-slot block tables: row b's token writes physical
+        block ``block_tables[b, pos_b // Bs]`` at offset ``pos_b % Bs``,
+        and attention gathers K/V through the same table (both decode-
+        attention impls). ``pools``: ``{"k"/"v": [L, N, Bs, H, D]}``;
+        ``block_tables``: [B, NB] int32. Rows stay independent — the
+        engine guarantees a written block is uniquely owned (copy-on-
+        write happens host-side before the step), and a dead row's
+        table points at the null block, where its gated write rewrites
+        old bytes."""
+        from ..ops.pallas.decode_attention import paged_decode_attention
+        c = self.cfg
+        b = tok.shape[0]
+        bs = pools["k"].shape[2]
+        nb = block_tables.shape[1]
+        impl = decode_attention or self.decode_attention_impl
+        pos = jnp.clip(jnp.asarray(pos, jnp.int32), 0, nb * bs - 1)
+        pad = jnp.asarray(pad, jnp.int32)
+        bt = jnp.asarray(block_tables, jnp.int32)
+        if alive is None:
+            alive = jnp.ones((b,), bool)
+        alive = jnp.asarray(alive) != 0
+        pos_ids = jnp.clip(pos - pad, 0, c.max_len - 1)
+        h, _ = self._embed(params, tok[:, None], pos_ids[:, None],
+                           rng=None, train=False)
+        h = h[:, 0]                                       # [B, hid]
+        rows = jnp.arange(b)
+        pbid = bt[rows, pos // bs]                        # [B] physical
+        off = pos % bs
+
+        def body(h, xs):
+            lp, ck, cv = xs
+            qkv = nn.dense(self._dequant(lp["qkv"]),
+                           nn.layernorm(lp["ln1"], h), dtype=self.dtype)
+            q, k, v = [x.reshape(b, c.heads, self.head_dim)
+                       for x in jnp.split(qkv, 3, axis=-1)]
+            k_w = jnp.where(alive[:, None, None],
+                            k.astype(ck.dtype), ck[pbid, off])
+            v_w = jnp.where(alive[:, None, None],
+                            v.astype(cv.dtype), cv[pbid, off])
+            ck = ck.at[pbid, off].set(k_w)
+            cv = cv.at[pbid, off].set(v_w)
+            ctx = paged_decode_attention(q, ck, cv, block_tables=bt,
+                                         pos=pos, pad=pad, impl=impl)
+            a = nn.dense(self._dequant(lp["o"]), ctx.reshape(b, c.hidden),
+                         dtype=self.dtype)
+            h = h + a.astype(h.dtype)
+            f = nn.dense(self._dequant(lp["ffn_in"]),
+                         nn.layernorm(lp["ln2"], h), dtype=self.dtype)
+            f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
+            f = nn.dense(self._dequant(lp["ffn_out"]), f, dtype=self.dtype)
+            h = h + f.astype(h.dtype)
+            return h, (ck, cv)
+
+        h, (ks, vs) = lax.scan(body, h,
+                               (stacked, pools["k"], pools["v"]))
         h = nn.layernorm(params["ln_f"], h)
         return (self.lm_logits(params, h[:, None])[:, 0],
                 {"k": ks, "v": vs})

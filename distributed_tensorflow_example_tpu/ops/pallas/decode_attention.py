@@ -128,6 +128,174 @@ def xla_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return ctx[:, 0]
 
 
+# ---------------------------------------------------------------------------
+# block-paged decode attention (round 10): K/V live in a shared block pool
+# [N, block_size, H, D] instead of per-slot slabs; each row's logical cache
+# is the run of physical blocks its block-table row names. Both impls gather
+# THROUGH the table: the XLA fallback with one advanced-indexing gather (then
+# the exact slab reference math), the kernel with scalar-prefetch index maps
+# (the block id is read from SMEM before each K/V block's DMA is issued — no
+# gathered [B, T, H, D] tensor ever exists).
+# ---------------------------------------------------------------------------
+
+def paged_tile_friendly(block_size: int, head_dim: int) -> bool:
+    """Paged-kernel tile constraints: each score row is [1, block_size]
+    (block_size in the lane dim — 128-multiples) and the context matmul
+    wants the same MXU-aligned head dim as the slab kernel."""
+    return block_size % 128 == 0 and (head_dim == 64
+                                      or head_dim % 128 == 0)
+
+
+def xla_paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                               v_pool: jax.Array, *, block_tables,
+                               pos, pad) -> jax.Array:
+    """Reference path: gather each row's block run out of the pool (one
+    advanced-indexing gather -> the row's [T, H, D] logical cache, with
+    T = blocks_per_row * block_size) and run the exact slab reference.
+    Bitwise equal to the slab path on equal logical contents — the
+    paged byte-parity oracle."""
+    n, bs, h, d = k_pool.shape
+    bt = jnp.asarray(block_tables, jnp.int32)
+    b, nb = bt.shape
+    kg = k_pool[bt].reshape(b, nb * bs, h, d)
+    vg = v_pool[bt].reshape(b, nb * bs, h, d)
+    return xla_decode_attention(q, kg, vg, pos=pos, pad=pad)
+
+
+def _paged_kernel(bt_ref, pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, block_size: int,
+                  sm_scale: float):
+    """Grid (B, H, NB): one [block_size, D] K/V block per step, gathered
+    through the block table by the index maps (scalar prefetch). The
+    softmax runs online over the NB dimension (m/l/acc scratch persists
+    across the revisited output block); masked slots are zeroed
+    explicitly so never-written pool blocks (incl. the engine's null
+    block) contribute exact 0 regardless of their bytes."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[0, 0] = NEG_INF
+        l_ref[0, 0] = 0.0
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)                    # [1, D]
+    k = k_ref[0].astype(jnp.float32)                    # [Bs, D]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * sm_scale
+    kpos = j * block_size + lax.broadcasted_iota(
+        jnp.int32, (1, block_size), 1)
+    live = (kpos <= pos_ref[b]) & (kpos >= pad_ref[b])
+    s = jnp.where(live, s, NEG_INF)
+    m_prev = m_ref[0, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s))
+    # explicit zeroing (not exp underflow): with the finite NEG_INF fill
+    # an all-masked block would otherwise see exp(NEG_INF - NEG_INF) = 1
+    p = jnp.where(live, jnp.exp(s - m_new), 0.0)        # [1, Bs]
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_ref[0, 0] * alpha + jnp.sum(p)
+    acc_ref[...] = (acc_ref[...] * alpha
+                    + lax.dot_general(
+                        p.astype(v_ref.dtype), v_ref[0],
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32))
+    m_ref[0, 0] = m_new
+    l_ref[0, 0] = l_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        # slot `pos` is always live, so l >= exp(0) > 0
+        o_ref[0] = (acc_ref[...] / l_ref[0, 0]).astype(o_ref.dtype)
+
+
+def _paged_dispatch(q, k_pool, v_pool, block_tables, pos, pad):
+    """Grid (B, H, NB); per program ONE [Bs, D] K/V plane of the pool,
+    selected by the block table via scalar-prefetch index maps. Same
+    [N, Bs, H·D]-view trick as the slab kernel so every tile is
+    Mosaic-friendly."""
+    n, bs, h, d = k_pool.shape
+    b, nb = block_tables.shape
+    q3 = q.reshape(b * h, 1, d)
+    k3 = k_pool.reshape(n, bs, h * d)
+    v3 = v_pool.reshape(n, bs, h * d)
+
+    def kv_map(bb, hh, jj, bt, pos_s, pad_s):
+        return (bt[bb, jj], 0, hh)
+
+    def q_map(bb, hh, jj, bt, pos_s, pad_s):
+        return (bb * h + hh, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,          # block_tables, pos, pad
+        grid=(b, h, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), q_map),
+            pl.BlockSpec((1, bs, d), kv_map),
+            pl.BlockSpec((1, bs, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.SMEM((1, 1), jnp.float32),            # running max
+            pltpu.SMEM((1, 1), jnp.float32),            # running sum
+            pltpu.VMEM((1, d), jnp.float32),            # context acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, block_size=bs,
+                          sm_scale=1.0 / math.sqrt(d)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), v_pool.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(block_tables, pos, pad, q3, k3, v3)
+    return out.reshape(b, h, d)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, *, block_tables, pos, pad,
+                           impl: str = "auto") -> jax.Array:
+    """One-query attention against the block-paged cache pool.
+
+    ``q``: [B, H, D]; ``k_pool``/``v_pool``: [N, block_size, H, D]
+    shared physical blocks; ``block_tables``: [B, NB] int32 — row b's
+    logical slot j lives in ``pool[block_tables[b, j // Bs], j % Bs]``;
+    ``pos``/``pad``: [B] int32, the same live-window semantics as the
+    slab path (row b attends to logical slots ``pad_b <= j <= pos_b``).
+    Returns [B, H, D] context.
+
+    ``impl`` as in :func:`decode_attention`; the kernel path needs
+    :func:`paged_tile_friendly` shapes, anything else falls back to the
+    gather + slab-reference XLA path.
+    """
+    n, bs, h, d = k_pool.shape
+    b = q.shape[0]
+    if q.shape != (b, h, d):
+        raise ValueError(f"q shape {q.shape} != {(b, h, d)} from pool "
+                         f"{k_pool.shape}")
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown decode attention impl {impl!r}")
+    bt = jnp.asarray(block_tables, jnp.int32)
+    if bt.ndim != 2 or bt.shape[0] != b:
+        raise ValueError(f"block_tables shape {bt.shape} != ({b}, NB)")
+    use_kernel = (impl == "pallas"
+                  or (impl == "auto" and jax.default_backend() == "tpu"
+                      and paged_tile_friendly(bs, d)))
+    if use_kernel and not paged_tile_friendly(bs, d):
+        raise ValueError(
+            f"paged decode_attention kernel needs block_size % 128 == 0 "
+            f"and an MXU-aligned head dim, got block_size={bs} D={d} "
+            "(use impl='auto' for the XLA fallback)")
+    posb = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    padb = jnp.broadcast_to(jnp.asarray(pad, jnp.int32).reshape(-1), (b,))
+    if not use_kernel:
+        return xla_paged_decode_attention(q, k_pool, v_pool,
+                                          block_tables=bt, pos=posb,
+                                          pad=padb)
+    return _paged_dispatch(q, k_pool, v_pool, bt, posb, padb)
+
+
 def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                      pos, pad, impl: str = "auto") -> jax.Array:
     """One-query attention against the cache slab.

@@ -159,6 +159,8 @@ def export_generator(model, params, out_dir: str, *,
                      decode_impl: str = "stacked",
                      tokens_per_dispatch: int = 1,
                      stepwise: bool = False, slots: int = 8,
+                     paged: bool = False, block_size: int = 16,
+                     num_blocks: int | None = None,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Serialize ``model.generate`` (params baked; greedy or
     temperature/top-k/top-p sampling, optional EOS early-stop) as a
@@ -204,7 +206,20 @@ def export_generator(model, params, out_dir: str, *,
     recorded for the host-side per-request keys. Slot count, prompt
     capacity, and max context are recorded under the ``stepwise``
     metadata key (static shapes — the pool is the program's working
-    set, sized at export time)."""
+    set, sized at export time).
+
+    ``paged=True`` (requires ``stepwise``) exports BLOCK-PAGED stepwise
+    programs instead of the slab pair: the pool is ``[L, num_blocks,
+    block_size, H, D]`` shared physical blocks plus a per-slot block
+    table, prefill writes whole blocks through a table row
+    (left-aligned layout — see ``GPT.paged_prefill``), and the decode
+    step reads/writes through ``[slots, blocks_per_slot]`` tables.
+    ``num_blocks`` defaults to the slab pool's byte capacity plus the
+    reserved null block (block 0 — never allocated; unused table
+    entries point at it). Slab artifacts remain exportable (the
+    default) as the paged path's parity oracle; ``block_size`` /
+    ``num_blocks`` land in the ``stepwise`` metadata so the engine and
+    bench rows can report block-level residency."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
 
@@ -249,11 +264,15 @@ def export_generator(model, params, out_dir: str, *,
         # samples host-side with per-request keys under this impl.
         extra_meta["prng_impl"] = str(
             jax.random.key_impl(jax.random.key(0)))
+    if paged and not stepwise:
+        raise ValueError("paged=True exports the block-paged stepwise "
+                         "programs and requires stepwise=True")
     if stepwise:
         extra_meta["stepwise"] = _export_stepwise(
             model, params, out_dir, prompt_len=prompt_len,
             max_new_tokens=max_new_tokens, slots=slots,
-            decode_attention=decode_attention, platforms=platforms)
+            decode_attention=decode_attention, platforms=platforms,
+            paged=paged, block_size=block_size, num_blocks=num_blocks)
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
                            prompt_len=prompt_len,
@@ -265,10 +284,34 @@ def export_generator(model, params, out_dir: str, *,
                            **extra_meta)
 
 
+def _trace_and_write_stepwise(out_dir: str, prefill_fn, decode_fn,
+                              prefill_specs: dict, decode_specs: dict,
+                              platforms: Sequence[str],
+                              base_meta: dict, **extra_meta) -> dict:
+    """The shared tail of both stepwise exporters (slab and paged):
+    trace + serialize the prefill/decode pair to the canonical
+    filenames (chief-only write) and assemble the ``stepwise``
+    metadata block. ONE copy, so an export-flow change (donation
+    hints, platform knobs, a new metadata key the engine reads) cannot
+    silently diverge the two artifact kinds."""
+    prefill_exp = jax_export.export(
+        jax.jit(prefill_fn), platforms=list(platforms))(prefill_specs)
+    decode_exp = jax_export.export(
+        jax.jit(decode_fn), platforms=list(platforms))(decode_specs)
+    if jax.process_index() == 0:
+        os.makedirs(out_dir, exist_ok=True)
+        for name, exp in ((_PREFILL, prefill_exp), (_DECODE, decode_exp)):
+            with open(os.path.join(out_dir, name), "wb") as f:
+                f.write(exp.serialize())
+    return {**base_meta, **extra_meta}
+
+
 def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
                      max_new_tokens: int, slots: int,
                      decode_attention: str | None,
-                     platforms: Sequence[str]) -> dict:
+                     platforms: Sequence[str], paged: bool = False,
+                     block_size: int = 16,
+                     num_blocks: int | None = None) -> dict:
     """Trace + serialize the prefill and shared-decode-step programs
     (see :func:`export_generator` ``stepwise=True``); returns the
     ``stepwise`` metadata block. Params are already host-gathered."""
@@ -280,8 +323,27 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
         raise ValueError(
             f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
             f"exceeds max_len {c.max_len}")
-    head_dim = c.hidden // c.heads
     cache_dtype = np.dtype(jnp.dtype(model.dtype))
+
+    def base_meta(pool_shape) -> dict:
+        return {
+            "slots": slots,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new_tokens,
+            "max_context": total,
+            "pool_shape": list(pool_shape),
+            "cache_dtype": str(cache_dtype),
+            "vocab_size": c.vocab_size,
+        }
+
+    if paged:
+        return _export_stepwise_paged(
+            model, params, out_dir, prompt_len=prompt_len,
+            max_new_tokens=max_new_tokens, slots=slots,
+            decode_attention=decode_attention, platforms=platforms,
+            block_size=block_size, num_blocks=num_blocks,
+            cache_dtype=cache_dtype, base_meta=base_meta)
+    head_dim = c.hidden // c.heads
     pool_shape = (c.layers, slots, total, c.heads, head_dim)
 
     def prefill_fn(feats):
@@ -321,24 +383,82 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
         "pos": jax.ShapeDtypeStruct((slots,), np.int32),
         "pad": jax.ShapeDtypeStruct((slots,), np.int32),
         "alive": jax.ShapeDtypeStruct((slots,), np.int32), **pool_specs}
-    prefill_exp = jax_export.export(
-        jax.jit(prefill_fn), platforms=list(platforms))(prefill_specs)
-    decode_exp = jax_export.export(
-        jax.jit(decode_fn), platforms=list(platforms))(decode_specs)
-    if jax.process_index() == 0:
-        os.makedirs(out_dir, exist_ok=True)
-        for name, exp in ((_PREFILL, prefill_exp), (_DECODE, decode_exp)):
-            with open(os.path.join(out_dir, name), "wb") as f:
-                f.write(exp.serialize())
-    return {
-        "slots": slots,
-        "prompt_len": prompt_len,
-        "max_new_tokens": max_new_tokens,
-        "max_context": total,
-        "pool_shape": list(pool_shape),
-        "cache_dtype": str(cache_dtype),
-        "vocab_size": c.vocab_size,
-    }
+    return _trace_and_write_stepwise(
+        out_dir, prefill_fn, decode_fn, prefill_specs, decode_specs,
+        platforms, base_meta(pool_shape))
+
+
+def _export_stepwise_paged(model, params, out_dir: str, *,
+                           prompt_len: int, max_new_tokens: int,
+                           slots: int, decode_attention: str | None,
+                           platforms: Sequence[str], block_size: int,
+                           num_blocks: int | None, cache_dtype,
+                           base_meta) -> dict:
+    """The block-paged stepwise pair (``export_generator``
+    ``paged=True``): prefill writes a prompt's whole blocks through a
+    table row, the shared decode step reads/writes through per-slot
+    tables. Same artifact filenames as the slab pair — the ``paged``
+    metadata key is the dispatch contract."""
+    c = model.cfg
+    total = prompt_len + max_new_tokens
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    blocks_per_slot = -(-total // block_size)
+    prompt_blocks = -(-prompt_len // block_size)
+    if num_blocks is None:
+        # default: the slab pool's token capacity, block-granular,
+        # plus the reserved null block — equal bytes, equal worst case
+        num_blocks = 1 + slots * blocks_per_slot
+    usable = num_blocks - 1
+    if usable < blocks_per_slot:
+        raise ValueError(
+            f"num_blocks {num_blocks} leaves {usable} usable blocks "
+            f"(block 0 is the reserved null block) but one full-depth "
+            f"request needs {blocks_per_slot} blocks of {block_size} "
+            "tokens — raise num_blocks or block_size")
+    head_dim = c.hidden // c.heads
+    pool_shape = (c.layers, num_blocks, block_size, c.heads, head_dim)
+
+    def prefill_fn(feats):
+        logits, ck, cv = model.paged_prefill(
+            params, feats["input_ids"], feats["prompt_mask"],
+            feats["cache_k"], feats["cache_v"], feats["table_row"])
+        return {"logits": logits, "cache_k": ck, "cache_v": cv}
+
+    stacked = model.stack_decode_params(params)
+
+    def decode_fn(feats):
+        logits, new = model.decode_step_batched_paged(
+            params, stacked,
+            {"k": feats["cache_k"], "v": feats["cache_v"]},
+            feats["block_tables"], feats["tok"], feats["pos"],
+            feats["pad"], feats["alive"],
+            decode_attention=decode_attention)
+        return {"logits": logits, "cache_k": new["k"],
+                "cache_v": new["v"]}
+
+    pool_specs = {
+        "cache_k": jax.ShapeDtypeStruct(pool_shape, cache_dtype),
+        "cache_v": jax.ShapeDtypeStruct(pool_shape, cache_dtype)}
+    prefill_specs = {
+        "input_ids": jax.ShapeDtypeStruct((1, prompt_len), np.int32),
+        "prompt_mask": jax.ShapeDtypeStruct((1, prompt_len), np.int32),
+        "table_row": jax.ShapeDtypeStruct((prompt_blocks,), np.int32),
+        **pool_specs}
+    decode_specs = {
+        "tok": jax.ShapeDtypeStruct((slots,), np.int32),
+        "pos": jax.ShapeDtypeStruct((slots,), np.int32),
+        "pad": jax.ShapeDtypeStruct((slots,), np.int32),
+        "alive": jax.ShapeDtypeStruct((slots,), np.int32),
+        "block_tables": jax.ShapeDtypeStruct((slots, blocks_per_slot),
+                                             np.int32),
+        **pool_specs}
+    return _trace_and_write_stepwise(
+        out_dir, prefill_fn, decode_fn, prefill_specs, decode_specs,
+        platforms, base_meta(pool_shape),
+        paged=True, block_size=block_size, num_blocks=num_blocks,
+        blocks_per_slot=blocks_per_slot, prompt_blocks=prompt_blocks,
+        layout="left_aligned")
 
 
 class ServableModel:
@@ -395,6 +515,10 @@ class StepwiseGenerator:
                 "re-export with export_generator(..., stepwise=True) "
                 "(or serve it with the scheduler off)")
         self.step_meta = step_meta
+        #: block-paged artifacts ([L, N, Bs, H, D] pool + block tables)
+        #: vs the slab pair ([L, slots, T, H, D]) — the engine branches
+        #: its allocator/prefix-cache machinery on this
+        self.paged: bool = bool(step_meta.get("paged", False))
         with open(os.path.join(directory, _PREFILL), "rb") as f:
             self._prefill_exp = jax_export.deserialize(f.read())
         with open(os.path.join(directory, _DECODE), "rb") as f:

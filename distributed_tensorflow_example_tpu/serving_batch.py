@@ -35,15 +35,33 @@ RNG streams by construction).
 
 Both schedulers enforce a bounded queue: a full queue raises
 :class:`QueueFullError`, which the HTTP layer maps to 429 +
-``Retry-After`` (replacing silent unbounded threading).
+``Retry-After`` (replacing silent unbounded threading); ``Retry-After``
+is the measured decode-step EMA × estimated steps-to-free
+(:class:`RetryAfterEstimator`), not a queue-depth guess.
+
+Round 10 — block-paged pool + shared-prefix reuse: with a PAGED
+stepwise artifact (``export_generator(..., paged=True)``) the engine
+swaps the ``slots × T`` slab reservation for a shared pool of
+``block_size``-token physical blocks plus per-slot block tables
+(:class:`BlockPool`: refcounted, allocate-on-write during decode,
+retirement returns blocks, block 0 reserved as the never-read null
+target). Admission consults a :class:`PrefixCache` (token-prefix hash
+at block granularity, LRU): a hit mounts the cached blocks by
+reference and teacher-forces only the uncached suffix through the
+SHARED decode step — zero prefill dispatches for a repeated prefix —
+and a write into a still-shared block copies it first (copy-on-write),
+so divergence can never corrupt a neighbor or the cache. Admission and
+429 are driven by BLOCK exhaustion, not slot count: concurrency is
+bounded by actual token residency.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import functools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 # the stdlib Future is the right primitive (set_result/set_exception/
 # result(timeout) — TimeoutError has been the builtin alias since 3.8);
 # the repo already leans on concurrent.futures elsewhere (async ckpt
@@ -62,6 +80,193 @@ class QueueFullError(Exception):
     def __init__(self, msg: str, retry_after: float = 1.0):
         super().__init__(msg)
         self.retry_after = retry_after
+
+
+class BlocksExhaustedError(Exception):
+    """The paged cache pool has no free physical block left (even after
+    prefix-cache eviction). The one request that needed the block fails
+    loudly; the engine keeps serving its neighbors."""
+
+
+class BlockPool:
+    """Host-side refcounted allocator over the physical blocks of a
+    paged KV-cache pool.
+
+    Block 0 is the reserved NULL block: never allocated, the target of
+    unused/dead block-table entries — whole-block prefill spill and the
+    gated dead-row write land there and are never read (the attention
+    mask excludes every logical slot past ``pos``). A block returns to
+    the free list exactly when its LAST reference drops: slot tables
+    and prefix-cache entries each hold one reference, so a shared
+    prefix block outlives any single request that mounted it.
+    Single-threaded by design — only the scheduler thread touches it.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (the reserved "
+                             f"null block + at least one usable), got "
+                             f"{num_blocks}")
+        self.num_blocks = num_blocks
+        self._ref = [0] * num_blocks
+        # LIFO free list: recently retired blocks are remounted first;
+        # deterministic allocation order (tests rely on it), and holes
+        # from mixed-length retirement are served like any other block
+        # — physical contiguity is irrelevant, the table indirection IS
+        # the defragmenter
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def usable(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        """``n`` fresh blocks, refcount 1 each — all-or-nothing (a
+        caller never holds a partial run)."""
+        if n > len(self._free):
+            raise BlocksExhaustedError(
+                f"need {n} cache block(s), {len(self._free)} free "
+                f"(pool of {self.usable} usable blocks)")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def retain(self, blocks) -> None:
+        for b in blocks:
+            if self._ref[b] <= 0:
+                raise AssertionError(f"retain of free block {b}")
+            self._ref[b] += 1
+
+    def release(self, blocks) -> None:
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] < 0:
+                raise AssertionError(f"double release of block {b}")
+            if self._ref[b] == 0:
+                self._free.append(b)
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+
+class PrefixCache:
+    """Block-granularity prefix reuse: hash of a token prefix -> the
+    physical blocks whose K/V bytes ARE that prefix's.
+
+    Entries exist at every full-block boundary of an admitted cold
+    prompt (key = its first ``j * block_size`` tokens, value = its
+    first ``j`` blocks) plus one EXACT whole-prompt entry when the
+    prompt ends mid-block (value includes the partial tail block). The
+    left-aligned paged layout makes the cached bytes position-
+    independent facts of the token prefix — token i always sits at
+    logical slot i — so a hit mounts the blocks by reference (retain),
+    no copy. Each entry holds one refcount per block; LRU eviction
+    releases entries until the allocator can serve again, and a block
+    still mounted by a live slot simply survives its cache eviction.
+    """
+
+    def __init__(self, pool: BlockPool, block_size: int):
+        self.pool = pool
+        self.block_size = block_size
+        # key -> (blocks tuple, covered token count); insertion order
+        # doubles as LRU (move_to_end on touch)
+        self._entries: OrderedDict[bytes, tuple[tuple[int, ...], int]] \
+            = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(tokens: np.ndarray) -> bytes:
+        return np.ascontiguousarray(tokens, np.int32).tobytes()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, tokens: np.ndarray, *,
+               record: bool = True) -> tuple[int, tuple[int, ...]]:
+        """Longest cached prefix of ``tokens``: ``(n_tokens_hit,
+        blocks)`` — the exact whole-prompt entry wins, else the longest
+        full-block chain; ``(0, ())`` on a miss. Mounting (refcounting)
+        is the caller's move. ``record=False`` skips the hit/miss
+        counters — for probes that may not lead to an admission (a
+        block-pressure deferral retries the same request every step,
+        and one admission must count once)."""
+        bs = self.block_size
+        p = int(tokens.size)
+        probes = [p] + [j * bs for j in range(p // bs, 0, -1)
+                        if j * bs != p]
+        for n in probes:
+            key = self._key(tokens[:n])
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                if record:
+                    self.hits += 1
+                return n, e[0]
+        if record:
+            self.misses += 1
+        return 0, ()
+
+    def insert(self, tokens: np.ndarray, blocks) -> None:
+        """Record a cold prompt's block run: one entry per full-block
+        boundary plus the exact whole-prompt entry. Re-inserting a
+        known key only touches its LRU position."""
+        bs = self.block_size
+        p = int(tokens.size)
+        ends = sorted({*(j * bs for j in range(1, p // bs + 1)), p})
+        for n in ends:
+            nb = -(-n // bs)
+            key = self._key(tokens[:n])
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            ref = tuple(int(b) for b in blocks[:nb])
+            self.pool.retain(ref)
+            self._entries[key] = (ref, n)
+
+    def evict(self, need_free: int) -> None:
+        """Release LRU entries until ``need_free`` blocks are free (or
+        the cache is empty — blocks still mounted by live slots stay
+        resident past their entry's eviction)."""
+        while self.pool.free_count < need_free and self._entries:
+            _, (blocks, _) = self._entries.popitem(last=False)
+            self.pool.release(blocks)
+
+
+class RetryAfterEstimator:
+    """Retry-After from MEASURED service rate: an EMA over decode-step
+    wall times × the estimated steps until a slot frees (scaled by how
+    many admission waves the queue ahead represents). Replaces the
+    round-9 queue-depth linear guess, which knew nothing about how
+    fast steps actually drain."""
+
+    def __init__(self, alpha: float = 0.2):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self.ema_step_s: float | None = None
+
+    def observe(self, step_s: float) -> None:
+        if self.ema_step_s is None:
+            self.ema_step_s = float(step_s)
+        else:
+            self.ema_step_s += self.alpha * (step_s - self.ema_step_s)
+
+    def estimate(self, steps_to_free: float, *, queue_ahead: int = 0,
+                 slots: int = 1) -> float:
+        """Seconds until the caller plausibly gets a slot: EMA step
+        latency × steps-to-free × admission waves ahead. 1.0 before
+        any step has been measured (no signal beats a fake one)."""
+        if self.ema_step_s is None:
+            return 1.0
+        waves = 1.0 + queue_ahead / max(1, slots)
+        return max(0.1, self.ema_step_s * max(1.0, steps_to_free)
+                   * waves)
 
 
 def percentile(samples, q: float) -> float:
@@ -127,6 +332,23 @@ class _Slot:
         self.rng = rng
         self.tokens: list[int] = []
         self.last_tok = 0
+        # paged prefix-reuse admission: KNOWN prompt tokens still to be
+        # fed through the shared step (teacher-forced — their logits
+        # are discarded until the last one, whose logits are the first
+        # sample point). Empty on the cold/prefill path.
+        self.forced: list[int] = []
+        # partial-hit admissions: the full prompt to insert into the
+        # prefix cache once the forced suffix has been written — so an
+        # identical repeat becomes an exact hit instead of re-forcing
+        # the suffix forever (None = cold path inserted at prefill, or
+        # exact hit whose entries already exist)
+        self.pending_insert: np.ndarray | None = None
+
+    def remaining_steps(self) -> int:
+        """Steps until this slot retires at its max_new bound (EOS may
+        retire it sooner) — the Retry-After steps-to-free signal."""
+        return len(self.forced) + max(1, self.req.max_new
+                                      - len(self.tokens))
 
 
 class GenerationEngine:
@@ -138,7 +360,7 @@ class GenerationEngine:
     """
 
     def __init__(self, stepwise: StepwiseGenerator, *,
-                 max_queue: int = 64):
+                 max_queue: int = 64, prefix_cache: bool = True):
         self.sw = stepwise
         m = stepwise.step_meta
         self.slots: int = int(m["slots"])
@@ -171,6 +393,50 @@ class GenerationEngine:
         self.requests_done = 0
         self.tokens_out = 0
         self._latencies: deque[float] = deque(maxlen=2048)
+        self._retry = RetryAfterEstimator()
+        # min remaining steps over live slots, refreshed by the
+        # scheduler thread after each shared step — a plain float so
+        # submit threads can read it without touching _live
+        self._steps_to_free_hint: float = 1.0
+        # ---- block-paged pool state (paged stepwise artifacts) ------
+        self.paged: bool = bool(getattr(stepwise, "paged", False))
+        self.prefill_tokens_saved = 0
+        self.cow_copies = 0
+        if self.paged:
+            self.block_size = int(m["block_size"])
+            self.num_blocks = int(m["num_blocks"])
+            self.blocks_per_slot = int(m["blocks_per_slot"])
+            self.prompt_blocks = int(m["prompt_blocks"])
+            self.blocks = BlockPool(self.num_blocks)
+            self.prefix_cache = (PrefixCache(self.blocks,
+                                             self.block_size)
+                                 if prefix_cache else None)
+            # per-slot block tables, host-owned (the decode program
+            # takes them as a per-step operand; 0 = the null block)
+            self._tables = np.zeros((self.slots, self.blocks_per_slot),
+                                    np.int32)
+            shape = m["pool_shape"]                # [L, N, Bs, H, D]
+            self._block_bytes = 2 * int(np.prod(
+                [shape[0], shape[2], shape[3], shape[4]])) * np.dtype(
+                    m["cache_dtype"]).itemsize
+            self._copy_block = self._make_block_copy()
+        else:
+            self.prefix_cache = None
+
+    @staticmethod
+    def _make_block_copy():
+        """Jitted device-side whole-block copy for copy-on-write (one
+        executable, scalar block ids as runtime args; the pool is
+        donated like every other pool-threading call)."""
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def copy(pool, src, dst):
+            return {k: v.at[:, dst].set(v[:, src])
+                    for k, v in pool.items()}
+
+        return lambda pool, src, dst: copy(pool, np.int32(src),
+                                           np.int32(dst))
 
     # ---- client side -------------------------------------------------
     def _make_request(self, prompt, *, max_new: int | None = None,
@@ -263,10 +529,15 @@ class GenerationEngine:
         return self.submit(prompt, **kw).result(timeout)
 
     def _retry_after(self) -> float:
-        """A Retry-After estimate: the time to drain roughly one
-        generation's worth of work per free-slot wave."""
-        lat = percentile(list(self._latencies), 50) or 1.0
-        return max(1.0, round(lat * (1 + len(self._queue) / self.slots), 1))
+        """Retry-After from the measured decode-step EMA × estimated
+        steps until a slot frees × the admission waves the current
+        queue represents. Reads ``_steps_to_free_hint`` — a scalar the
+        scheduler thread refreshes each step — rather than iterating
+        ``_live``, which only the scheduler thread may touch (HTTP
+        submit threads land here on a full queue)."""
+        return round(self._retry.estimate(
+            self._steps_to_free_hint, queue_ahead=len(self._queue),
+            slots=self.slots), 2)
 
     # ---- scheduler thread --------------------------------------------
     def start(self) -> "GenerationEngine":
@@ -329,10 +600,29 @@ class GenerationEngine:
                     self._live.clear()
                     self._free = list(range(self.slots))[::-1]
                 self._pool = self.sw.make_pool()
+                if self.paged:
+                    # the rebuilt pool is empty: every table entry and
+                    # cached prefix names bytes that no longer exist
+                    hits, misses = 0, 0
+                    if self.prefix_cache is not None:
+                        hits = self.prefix_cache.hits
+                        misses = self.prefix_cache.misses
+                    self._tables[:] = 0
+                    self.blocks = BlockPool(self.num_blocks)
+                    if self.prefix_cache is not None:
+                        self.prefix_cache = PrefixCache(self.blocks,
+                                                        self.block_size)
+                        self.prefix_cache.hits = hits
+                        self.prefix_cache.misses = misses
 
     def _admit(self) -> None:
-        """Drain the queue into free slots (one prefill each). Runs
-        between shared steps — prefill joins mid-flight."""
+        """Drain the queue into free slots. Runs between shared steps —
+        admission joins mid-flight. Slab path: one prefill dispatch per
+        admission. Paged path: prefix-cache hits mount existing blocks
+        and teacher-force the uncached suffix through the SHARED step
+        (zero prefill dispatches); misses allocate a block run and run
+        the paged prefill. Block pressure pushes the request back to
+        the queue head — retirement (or cache eviction) clears it."""
         while True:
             with self._cond:
                 if not self._queue or not self._free:
@@ -340,23 +630,155 @@ class GenerationEngine:
                 req = self._queue.popleft()
                 index = self._free.pop()
                 self._admitting = req
-            ids = np.zeros((1, self.prompt_len), np.int32)
-            mask = np.zeros((1, self.prompt_len), np.int32)
-            p = req.prompt.size
-            ids[0, :p] = req.prompt
-            mask[0, :p] = 1
-            out = self.sw.prefill({
-                "input_ids": ids, "prompt_mask": mask,
-                "slot": np.int32(index), **self._pool})
-            self._pool = {"cache_k": out["cache_k"],
-                          "cache_v": out["cache_v"]}
-            self.prefills += 1
-            slot = _Slot(req, index, pad=int(np.asarray(out["pad"])[0]),
-                         pos=self.prompt_len, rng=req.sampler())
-            tok = self._pick(slot, np.asarray(out["logits"])[0])
-            self._emit(slot, tok)
+            if self.paged:
+                admitted = self._admit_paged(req, index)
+            else:
+                self._admit_slab(req, index)
+                admitted = True
             with self._cond:
                 self._admitting = None
+                if not admitted:
+                    return
+
+    def _admit_slab(self, req: GenRequest, index: int) -> None:
+        ids = np.zeros((1, self.prompt_len), np.int32)
+        mask = np.zeros((1, self.prompt_len), np.int32)
+        p = req.prompt.size
+        ids[0, :p] = req.prompt
+        mask[0, :p] = 1
+        out = self.sw.prefill({
+            "input_ids": ids, "prompt_mask": mask,
+            "slot": np.int32(index), **self._pool})
+        self._pool = {"cache_k": out["cache_k"],
+                      "cache_v": out["cache_v"]}
+        self.prefills += 1
+        slot = _Slot(req, index, pad=int(np.asarray(out["pad"])[0]),
+                     pos=self.prompt_len, rng=req.sampler())
+        tok = self._pick(slot, np.asarray(out["logits"])[0])
+        self._emit(slot, tok)
+
+    def _admit_paged(self, req: GenRequest, index: int) -> bool:
+        """Paged admission; returns False when block pressure defers
+        the request (re-queued at the head, slot index returned)."""
+        tokens = np.asarray(req.prompt, np.int32)
+        p = int(tokens.size)
+        # record=False: this probe repeats every step while the request
+        # is deferred under block pressure — hits/misses are counted
+        # below, exactly once per ADMISSION OUTCOME
+        n_hit, hit_blocks = ((self.prefix_cache.lookup(tokens,
+                                                       record=False))
+                             if self.prefix_cache is not None
+                             else (0, ()))
+        if n_hit:
+            self.prefix_cache.hits += 1
+            # Cache hit: mount the cached blocks by reference and feed
+            # the remaining KNOWN tokens through the shared decode step
+            # (teacher-forced). An EXACT whole-prompt hit re-feeds only
+            # the last prompt token — its logits are the first sample
+            # point, and its write copy-on-writes the shared tail block.
+            start = n_hit - 1 if n_hit == p else n_hit
+            self.blocks.retain(hit_blocks)
+            self._tables[index, :len(hit_blocks)] = hit_blocks
+            slot = _Slot(req, index, pad=0, pos=start, rng=req.sampler())
+            slot.last_tok = int(tokens[start])
+            slot.forced = [int(t) for t in tokens[start + 1:]]
+            if n_hit < p:
+                # once the suffix is teacher-forced in, cache the FULL
+                # prompt so an identical repeat exact-hits (the suffix
+                # blocks' bytes are decode-computed — same token-level
+                # parity contract as the forcing itself)
+                slot.pending_insert = tokens
+            self.prefill_tokens_saved += start
+            self._live[index] = slot
+            return True
+        # Cold: allocate the prompt's block run (evicting LRU cache
+        # entries under pressure) and run the paged prefill program.
+        needed = -(-p // self.block_size)
+        try:
+            if self.blocks.free_count < needed \
+                    and self.prefix_cache is not None:
+                self.prefix_cache.evict(needed)
+            run = self.blocks.alloc(needed)
+        except BlocksExhaustedError as e:
+            if self._live:
+                # retirement will free blocks — try again next boundary
+                with self._cond:
+                    self._queue.appendleft(req)
+                    self._free.append(index)
+                return False
+            # nothing live, cache already evicted: the pool simply
+            # cannot hold this prompt — fail IT, keep serving
+            if self.prefix_cache is not None:
+                self.prefix_cache.misses += 1
+            with self._cond:
+                self._free.append(index)
+            req.future.set_exception(BlocksExhaustedError(
+                f"prompt of {p} tokens needs {needed} cache blocks but "
+                f"the pool cannot free them: {e}"))
+            return True
+        table_row = np.zeros((self.prompt_blocks,), np.int32)
+        table_row[:needed] = run
+        ids = np.zeros((1, self.prompt_len), np.int32)
+        mask = np.zeros((1, self.prompt_len), np.int32)
+        ids[0, :p] = tokens
+        mask[0, :p] = 1
+        out = self.sw.prefill({
+            "input_ids": ids, "prompt_mask": mask,
+            "table_row": table_row, **self._pool})
+        self._pool = {"cache_k": out["cache_k"],
+                      "cache_v": out["cache_v"]}
+        self.prefills += 1
+        self._tables[index, :needed] = run
+        if self.prefix_cache is not None:
+            self.prefix_cache.misses += 1
+            self.prefix_cache.insert(tokens, run)
+        slot = _Slot(req, index, pad=0, pos=p, rng=req.sampler())
+        tok = self._pick(slot, np.asarray(out["logits"])[0])
+        self._emit(slot, tok)
+        return True
+
+    def _release_slot_blocks(self, index: int) -> None:
+        """Retirement/failure: drop this slot's table references (a
+        block shared with the prefix cache or another slot survives —
+        freed only at its LAST release) and reset the row to the null
+        block."""
+        row = self._tables[index]
+        ids = [int(b) for b in row if b]
+        if ids:
+            self.blocks.release(ids)
+        row[:] = 0
+
+    def _fail_slot(self, slot: _Slot, err: Exception) -> None:
+        """Fail ONE live request loudly (mid-decode block exhaustion)
+        without disturbing its neighbors."""
+        self._release_slot_blocks(slot.index)
+        del self._live[slot.index]
+        with self._cond:
+            self._free.append(slot.index)
+        slot.req.future.set_exception(err)
+
+    def _ensure_write_block(self, slot: _Slot) -> None:
+        """Before a decode step writes at ``slot.pos``: allocate-on-
+        write when the target table entry is still the null block, and
+        copy-on-write when the target block is shared (prefix cache or
+        another slot still references it) — a divergence must never
+        mutate bytes someone else reads."""
+        bi = slot.pos // self.block_size
+        pb = int(self._tables[slot.index, bi])
+        if pb == 0:
+            if self.blocks.free_count < 1 \
+                    and self.prefix_cache is not None:
+                self.prefix_cache.evict(1)
+            self._tables[slot.index, bi] = self.blocks.alloc(1)[0]
+        elif self.blocks.refcount(pb) > 1:
+            if self.blocks.free_count < 1 \
+                    and self.prefix_cache is not None:
+                self.prefix_cache.evict(1)
+            nb = self.blocks.alloc(1)[0]
+            self._pool = self._copy_block(self._pool, pb, nb)
+            self._tables[slot.index, bi] = nb
+            self.blocks.release([pb])
+            self.cow_copies += 1
 
     def _pick(self, slot: _Slot, logits: np.ndarray) -> int:
         """Per-request sampling on the host side of the step boundary
@@ -386,6 +808,8 @@ class GenerationEngine:
                                                  - len(slot.tokens))
             self._latencies.append(time.perf_counter() - req.submitted_at)
             self.requests_done += 1
+            if self.paged:
+                self._release_slot_blocks(slot.index)
             with self._cond:
                 self._free.append(slot.index)
             req.future.set_result(toks)
@@ -394,6 +818,20 @@ class GenerationEngine:
 
     def _shared_step(self) -> None:
         """ONE batched decode step for every live slot."""
+        if self.paged:
+            # secure every live row's write target first: allocate-on-
+            # write at block boundaries, copy-on-write on shared blocks.
+            # A row that cannot get a block fails ALONE — its neighbors
+            # still step.
+            for s in list(self._live.values()):
+                try:
+                    self._ensure_write_block(s)
+                except BlocksExhaustedError as e:
+                    self._fail_slot(s, BlocksExhaustedError(
+                        f"out of cache blocks mid-decode after "
+                        f"{len(s.tokens)} tokens: {e}"))
+            if not self._live:
+                return
         tok = np.zeros((self.slots,), np.int32)
         pos = np.zeros((self.slots,), np.int32)
         pad = np.zeros((self.slots,), np.int32)
@@ -403,19 +841,42 @@ class GenerationEngine:
             pos[i] = s.pos
             pad[i] = s.pad
             alive[i] = 1
-        out = self.sw.decode({"tok": tok, "pos": pos, "pad": pad,
-                              "alive": alive, **self._pool})
+        feats = {"tok": tok, "pos": pos, "pad": pad, "alive": alive,
+                 **self._pool}
+        if self.paged:
+            feats["block_tables"] = self._tables
+        t0 = time.perf_counter()
+        out = self.sw.decode(feats)
         self._pool = {"cache_k": out["cache_k"],
                       "cache_v": out["cache_v"]}
+        logits = np.asarray(out["logits"])   # blocks on the step result
+        self._retry.observe(time.perf_counter() - t0)
         self.decode_steps += 1
         self.decode_slot_steps += len(self._live)
-        logits = np.asarray(out["logits"])
-        finished = []
         for i, s in list(self._live.items()):
             s.pos += 1
+            if s.forced:
+                # teacher-forced prompt suffix: the next token is
+                # already known — this step's logits are scaffolding
+                s.last_tok = s.forced.pop(0)
+                continue
+            if s.pending_insert is not None and \
+                    self.prefix_cache is not None:
+                # the whole prompt is now resident in this slot's
+                # blocks: cache it. Inserting shares the tail block,
+                # so this slot's NEXT write copy-on-writes it — the
+                # cached bytes stay pure, same as the cold path.
+                tokens = s.pending_insert
+                nb = -(-int(tokens.size) // self.block_size)
+                self.prefix_cache.insert(
+                    tokens, [int(b) for b in self._tables[s.index, :nb]])
+                s.pending_insert = None
             nxt = self._pick(s, logits[i])
             del self._live[i]           # _emit re-adds if still live
             self._emit(s, nxt)
+        live = list(self._live.values())
+        self._steps_to_free_hint = (
+            min(s.remaining_steps() for s in live) if live else 1.0)
 
     # ---- observability ----------------------------------------------
     def stats(self) -> dict:
@@ -425,7 +886,7 @@ class GenerationEngine:
             live = len(self._live)
         shared = (self.decode_slot_steps / self.decode_steps
                   if self.decode_steps else 0.0)
-        return {
+        out = {
             "slots": self.slots,
             "live_slots": live,
             "queue_depth": queue_depth,
@@ -439,6 +900,29 @@ class GenerationEngine:
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
             "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),
         }
+        if self.paged:
+            # block-level observability: residency is ACTUAL tokens,
+            # not slots × worst-case depth — the paged pool's whole
+            # point, so it must be visible at /stats
+            hits = misses = entries = 0
+            if self.prefix_cache is not None:
+                hits = self.prefix_cache.hits
+                misses = self.prefix_cache.misses
+                entries = len(self.prefix_cache)
+            resident = self.blocks.usable - self.blocks.free_count
+            out.update({
+                "paged": True,
+                "block_size": self.block_size,
+                "blocks_total": self.blocks.usable,
+                "blocks_free": self.blocks.free_count,
+                "bytes_resident": resident * self._block_bytes,
+                "prefix_cache_hits": hits,
+                "prefix_cache_misses": misses,
+                "prefix_cache_entries": entries,
+                "prefill_tokens_saved": self.prefill_tokens_saved,
+                "cow_copies": self.cow_copies,
+            })
+        return out
 
 
 class MicroBatcher:

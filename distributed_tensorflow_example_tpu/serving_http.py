@@ -95,7 +95,8 @@ class PredictServer:
     def __init__(self, export_dir: str, *, name: str | None = None,
                  host: str = "127.0.0.1", port: int = 0,
                  scheduler: str = "auto", batch_max_size: int = 8,
-                 batch_max_wait_ms: float = 5.0, max_queue: int = 64):
+                 batch_max_wait_ms: float = 5.0, max_queue: int = 64,
+                 prefix_cache: bool = True):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
@@ -127,7 +128,8 @@ class PredictServer:
                         "with scheduler='off'")
                 from .serving import load_stepwise
                 self.engine = GenerationEngine(
-                    load_stepwise(export_dir), max_queue=max_queue).start()
+                    load_stepwise(export_dir), max_queue=max_queue,
+                    prefix_cache=prefix_cache).start()
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
@@ -588,12 +590,18 @@ def main(argv=None) -> int:
                     help=":predict admission window per micro-batch")
     ap.add_argument("--max_queue", type=int, default=64,
                     help="admission queue bound (full -> 429)")
+    ap.add_argument("--prefix_cache", choices=("on", "off"),
+                    default="on",
+                    help="paged artifacts only: shared-prefix block "
+                    "reuse at admission (off = every prompt prefills "
+                    "cold — the shared-vs-cold parity tool)")
     args = ap.parse_args(argv)
     srv = PredictServer(args.export_dir, name=args.name, host=args.host,
                         port=args.port, scheduler=args.scheduler,
                         batch_max_size=args.batch_max_size,
                         batch_max_wait_ms=args.batch_max_wait_ms,
-                        max_queue=args.max_queue)
+                        max_queue=args.max_queue,
+                        prefix_cache=args.prefix_cache == "on")
     print(f"serving {srv.name!r} on http://{args.host}:{srv.port}"
           f"/v1/models/{srv.name}:predict", flush=True)
     srv.serve()

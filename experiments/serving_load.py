@@ -22,15 +22,28 @@ Each mode's row reports:
 The greedy outputs of the two modes are asserted byte-identical per
 request (the parity contract) unless ``--no_parity``.
 
+Round 10 adds the block-paged legs: ``--paged`` (+ ``--block_size`` /
+``--num_blocks``) serves the block-paged stepwise artifacts, and
+``--prefix_mode shared|cold`` shapes the WORKLOAD — ``shared``
+prepends one seeded system prefix to every prompt (the prefix-cache
+case at the millions-of-users north star), ``cold`` keeps fully
+random prompts. Paged rows additionally report
+``prefix_cache_hits`` / ``prefill_tokens_saved`` / ``cow_copies``.
+
 Usage::
 
     JAX_PLATFORMS=cpu python experiments/serving_load.py --smoke
     python experiments/serving_load.py --clients 8 --requests 8 \
         --slots 8 --prompt_len 64 --max_new 64
+    python experiments/serving_load.py --paged --block_size 16 \
+        --prompt_len 64 --prefix_mode shared
 
 Prints one JSON line per mode plus a ``summary`` line. ``--smoke`` is
-the tier-1 CPU configuration (2 clients, tiny model); the full matrix
-is registered as a ``slow`` test (tests/test_serving_load.py).
+the tier-1 CPU configuration (2 clients, tiny model) and ALSO runs the
+paged cold/shared legs, asserting paged-vs-slab byte parity,
+shared-vs-cold admission byte parity, and shared-mode prefill
+dispatches strictly below cold-mode; the full matrix is registered as
+a ``slow`` test (tests/test_serving_load.py).
 """
 
 import argparse
@@ -64,10 +77,13 @@ def _stats(port):
 
 def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                  slots: int, seed: int = 0, model_name: str = "gpt_tiny",
-                 platforms=("cpu",)):
+                 platforms=("cpu",), paged: bool = False,
+                 block_size: int = 16, num_blocks=None):
     """Seeded GPT stepwise export (ragged monolithic artifact too, so
     the off path serves the same mixed prompt lengths). ``platforms``
-    includes "tpu" when bench.py runs the serving row on chip."""
+    includes "tpu" when bench.py runs the serving row on chip;
+    ``paged=True`` exports the block-paged stepwise pair instead of
+    the slab pool."""
     import jax
     from distributed_tensorflow_example_tpu.config import TrainConfig
     from distributed_tensorflow_example_tpu.models import get_model
@@ -77,29 +93,59 @@ def build_export(out_dir: str, *, prompt_len: int, max_new: int,
     params = model.init(jax.random.key(seed))
     export_generator(model, params, out_dir, prompt_len=prompt_len,
                      max_new_tokens=max_new, batch_size=1, ragged=True,
-                     stepwise=True, slots=slots,
+                     stepwise=True, slots=slots, paged=paged,
+                     block_size=block_size, num_blocks=num_blocks,
                      platforms=tuple(platforms))
     return model.cfg.vocab_size
 
 
 def make_requests(clients: int, requests: int, *, prompt_len: int,
-                  max_new: int, vocab: int, seed: int):
+                  max_new: int, vocab: int, seed: int,
+                  prefix_mode: str = "cold", block_size: int = 16):
     """The seeded request matrix: [client][request] -> (prompt ids,
-    max_new). Mixed lengths, identical across modes (same seed)."""
+    max_new). Mixed lengths, identical across modes (same seed).
+
+    ``prefix_mode="shared"`` models the millions-of-users shape: every
+    prompt starts with ONE seeded system prefix (length = the largest
+    ``block_size`` multiple that leaves suffix room, at least
+    ``block_size``) followed by a short random user suffix — the
+    workload the paged engine's prefix cache exists for.
+    ``"cold"`` keeps fully random prompts (every admission misses)."""
+    if prefix_mode not in ("cold", "shared"):
+        raise ValueError(f"prefix_mode must be cold/shared, got "
+                         f"{prefix_mode!r}")
     rs = np.random.RandomState(seed)
+    sys_prefix = None
+    if prefix_mode == "shared":
+        sys_len = max(block_size,
+                      (prompt_len - 1) // block_size * block_size)
+        if sys_len >= prompt_len:
+            raise ValueError(
+                f"prompt_len {prompt_len} leaves no suffix room after a "
+                f"{sys_len}-token shared prefix (block_size "
+                f"{block_size}) — raise prompt_len or shrink block_size")
+        sys_prefix = rs.randint(0, vocab, (sys_len,)).astype(np.int32)
     matrix = []
     for _ in range(clients):
         rows = []
         for _ in range(requests):
-            p = int(rs.randint(1, prompt_len + 1))
+            if sys_prefix is None:
+                p = int(rs.randint(1, prompt_len + 1))
+                prompt = rs.randint(0, vocab, (p,)).astype(np.int32)
+            else:
+                s = int(rs.randint(1, prompt_len - sys_prefix.size + 1))
+                prompt = np.concatenate(
+                    [sys_prefix,
+                     rs.randint(0, vocab, (s,)).astype(np.int32)])
             m = int(rs.randint(1, max_new + 1))
-            rows.append((rs.randint(0, vocab, (p,)).astype(np.int32), m))
+            rows.append((prompt, m))
         matrix.append(rows)
     return matrix
 
 
 def run_mode(export_dir: str, matrix, *, scheduler: str,
-             prompt_len: int) -> dict:
+             prompt_len: int, mode_name: str | None = None,
+             prefix_cache: bool = True) -> dict:
     """Drive one server mode with the closed-loop client matrix;
     returns the result row (and stashes per-request generations under
     ``_gens`` for the parity check)."""
@@ -109,7 +155,8 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     lat: list[list[float]] = [[] for _ in range(clients)]
     gens: list[list[list[int]]] = [[] for _ in range(clients)]
     errors: list[str] = []
-    with PredictServer(export_dir, scheduler=scheduler) as srv:
+    with PredictServer(export_dir, scheduler=scheduler,
+                       prefix_cache=prefix_cache) as srv:
         def client(ci):
             for prompt, m in matrix[ci]:
                 if scheduler == "on":
@@ -157,7 +204,7 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
 
     g = stats.get("generate", {})
     row = {
-        "mode": f"scheduler_{scheduler}",
+        "mode": mode_name or f"scheduler_{scheduler}",
         "clients": clients,
         "requests": n_req,
         "errors": errors,
@@ -173,6 +220,14 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
         "steps_shared": g.get("steps_shared", 1.0),
         "_gens": gens,
     }
+    if g.get("paged"):
+        row.update({
+            "prefix_cache_hits": g["prefix_cache_hits"],
+            "prefix_cache_misses": g["prefix_cache_misses"],
+            "prefill_tokens_saved": g["prefill_tokens_saved"],
+            "blocks_total": g["blocks_total"],
+            "cow_copies": g["cow_copies"],
+        })
     return row
 
 
@@ -185,39 +240,108 @@ def main(argv=None) -> int:
     ap.add_argument("--prompt_len", type=int, default=16)
     ap.add_argument("--max_new", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--paged", action="store_true",
+                    help="export/serve the block-paged stepwise "
+                    "artifacts (block pool + prefix cache) instead of "
+                    "the slab pool")
+    ap.add_argument("--block_size", type=int, default=16,
+                    help="paged: tokens per physical cache block")
+    ap.add_argument("--num_blocks", type=int, default=None,
+                    help="paged: physical blocks in the pool (default: "
+                    "slab-equivalent capacity + the null block)")
+    ap.add_argument("--prefix_mode", choices=("cold", "shared"),
+                    default="cold",
+                    help="workload shape: 'shared' prepends one seeded "
+                    "system prefix to every prompt (the prefix-cache "
+                    "case); 'cold' keeps fully random prompts")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CPU config: 2 clients x 2 requests, "
-                    "tiny shapes")
+                    "tiny shapes; runs the slab on/off pair PLUS the "
+                    "paged cold/shared legs and asserts paged-vs-slab "
+                    "parity and shared-mode prefill savings")
     ap.add_argument("--no_parity", action="store_true",
                     help="skip the on-vs-off byte-identity assertion")
     args = ap.parse_args(argv)
     if args.smoke:
         args.clients, args.requests = 2, 2
         args.slots, args.prompt_len, args.max_new = 2, 8, 4
+        args.block_size = min(args.block_size, 4)
 
+    def matrix_for(vocab, prefix_mode):
+        return make_requests(args.clients, args.requests,
+                             prompt_len=args.prompt_len,
+                             max_new=args.max_new, vocab=vocab,
+                             seed=args.seed, prefix_mode=prefix_mode,
+                             block_size=args.block_size)
+
+    rows = []
+    checks = []          # (description, bool) pairs for the summary
     with tempfile.TemporaryDirectory() as d:
         vocab = build_export(d, prompt_len=args.prompt_len,
                              max_new=args.max_new, slots=args.slots,
-                             seed=args.seed)
-        matrix = make_requests(args.clients, args.requests,
-                               prompt_len=args.prompt_len,
-                               max_new=args.max_new, vocab=vocab,
-                               seed=args.seed)
+                             seed=args.seed, paged=args.paged,
+                             block_size=args.block_size,
+                             num_blocks=args.num_blocks)
+        matrix = matrix_for(vocab, args.prefix_mode)
+        # the exported dir always holds the monolithic artifact too,
+        # so scheduler=off is the oracle for slab AND paged runs
         rows = [run_mode(d, matrix, scheduler="on",
-                         prompt_len=args.prompt_len),
+                         prompt_len=args.prompt_len,
+                         mode_name=("paged_on" if args.paged
+                                    else "scheduler_on")),
                 run_mode(d, matrix, scheduler="off",
                          prompt_len=args.prompt_len)]
+        if args.smoke:
+            with tempfile.TemporaryDirectory() as dp:
+                build_export(dp, prompt_len=args.prompt_len,
+                             max_new=args.max_new, slots=args.slots,
+                             seed=args.seed, paged=True,
+                             block_size=args.block_size,
+                             num_blocks=1 + 4 * args.slots
+                             * -(-(args.prompt_len + args.max_new)
+                                 // args.block_size))
+                # the cold leg must be genuinely cold even when the
+                # main matrix was built with --prefix_mode shared —
+                # and its parity oracle must run the SAME matrix
+                if args.prefix_mode == "cold":
+                    cold, cold_off_gens = matrix, rows[1]["_gens"]
+                else:
+                    cold = matrix_for(vocab, "cold")
+                    cold_off_gens = run_mode(
+                        dp, cold, scheduler="off",
+                        prompt_len=args.prompt_len,
+                        mode_name="cold_off")["_gens"]
+                paged_cold = run_mode(dp, cold, scheduler="on",
+                                      prompt_len=args.prompt_len,
+                                      mode_name="paged_cold")
+                shared = matrix_for(vocab, "shared")
+                paged_shared = run_mode(dp, shared, scheduler="on",
+                                        prompt_len=args.prompt_len,
+                                        mode_name="paged_shared")
+                shared_off = run_mode(dp, shared, scheduler="off",
+                                      prompt_len=args.prompt_len,
+                                      mode_name="shared_off")
+            rows += [paged_cold, paged_shared, shared_off]
+            checks += [
+                ("paged_vs_slab_parity",
+                 paged_cold["_gens"] == cold_off_gens),
+                ("shared_vs_cold_admission_parity",
+                 paged_shared["_gens"] == shared_off["_gens"]),
+                ("shared_prefills_below_cold",
+                 paged_shared["prefills"] < paged_cold["prefills"]),
+            ]
 
     parity = None
     if not args.no_parity:
         parity = rows[0]["_gens"] == rows[1]["_gens"]
-    ok = (not rows[0]["errors"] and not rows[1]["errors"]
-          and parity is not False)
+    ok = (all(not r["errors"] for r in rows)
+          and parity is not False
+          and all(v for _, v in checks))
     for row in rows:
         row.pop("_gens")
         print(json.dumps(row))
-    on, off = rows
-    print(json.dumps({
+    on, off = rows[0], rows[1]
+    summary = {
         "summary": True,
         "ok": ok,
         "greedy_parity": parity,
@@ -227,7 +351,9 @@ def main(argv=None) -> int:
         "dispatch_ratio": round(
             off["decode_steps"] / on["decode_steps"], 3)
         if on["decode_steps"] else None,
-    }))
+    }
+    summary.update({name: v for name, v in checks})
+    print(json.dumps(summary))
     return 0 if ok else 1
 
 

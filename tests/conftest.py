@@ -28,3 +28,44 @@ def cpu8():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected >=8 cpu devices, got {len(devs)}"
     return devs
+
+
+KNOWN_FAILURES_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "known_failures.txt")
+
+
+def load_known_failures() -> list[str]:
+    """The documented pre-existing tier-1 failure set, one node id per
+    line ('#' comments skipped) — THE parser, shared by the drift
+    banner below and tests/test_known_failures_guard.py."""
+    with open(KNOWN_FAILURES_FILE) as f:
+        return [ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")]
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Known-failure-set drift banner: tier-1 carries a documented
+    pre-existing failure set (docs/known_failures.txt); any failure
+    NOT on that list is flagged here by name so a fresh regression can
+    never hide inside the known-bad count (see
+    tests/test_known_failures_guard.py for the companion re-run
+    guard). Print-only — the run's exit status already reflects the
+    failures themselves."""
+    failed = [r.nodeid for r in terminalreporter.stats.get("failed", [])]
+    if not failed:
+        return
+    try:
+        known = set(load_known_failures())
+    except OSError:
+        return
+    drift = sorted(set(failed) - known)
+    if drift:
+        terminalreporter.section("KNOWN-FAILURE-SET DRIFT",
+                                 sep="=", red=True, bold=True)
+        terminalreporter.line(
+            f"{len(drift)} failed test(s) NOT on the documented "
+            "pre-existing list (docs/known_failures.txt) — these are "
+            "NEW regressions, not sandbox noise:")
+        for n in drift:
+            terminalreporter.line(f"  {n}")

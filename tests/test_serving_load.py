@@ -26,17 +26,30 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert summary and summary[0]["ok"]
     assert summary[0]["greedy_parity"] is True
     modes = {r["mode"]: r for r in rows if "mode" in r}
-    assert set(modes) == {"scheduler_on", "scheduler_off"}
+    assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
+                          "paged_shared", "shared_off"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
     # the dispatch story reaches the row: shared steps recorded
     assert on["decode_steps"] <= on["requests"] * 4   # smoke max_new=4
+    # round-10 paged legs: byte parity paged-vs-slab and
+    # shared-vs-cold admission, and the prefix cache genuinely saves
+    # prefill dispatches on the shared workload
+    s = summary[0]
+    assert s["paged_vs_slab_parity"] is True
+    assert s["shared_vs_cold_admission_parity"] is True
+    assert s["shared_prefills_below_cold"] is True
+    assert (modes["paged_shared"]["prefills"]
+            < modes["paged_cold"]["prefills"])
+    assert modes["paged_shared"]["prefix_cache_hits"] > 0
+    assert modes["paged_shared"]["prefill_tokens_saved"] > 0
 
 
 def test_bench_serving_row_publishes_keys():
     """bench.py's serving row must publish the {key}_serving_tps /
-    {key}_serving_p95_ms columns the next TPU window baselines."""
+    {key}_serving_p95_ms columns the next TPU window baselines, plus
+    the round-10 {key}_serving_prefix_hit_rate paged-leg column."""
     import bench
     row = bench._run_serving(clients=2, requests=1, prompt_len=8,
                              max_new=4, slots=2, tiny=True)
@@ -44,6 +57,9 @@ def test_bench_serving_row_publishes_keys():
     assert row["serving_p95_ms"] > 0
     assert row["serving_errors"] == 0
     assert row["serving_decode_steps"] >= 1
+    assert row["serving_paged_errors"] == 0
+    assert 0.0 <= row["serving_prefix_hit_rate"] <= 1.0
+    assert row["serving_paged_tps"] > 0
 
 
 @pytest.mark.slow
@@ -65,3 +81,25 @@ def test_full_load_matrix():
     assert summary["dispatch_ratio"] > 1.0, (
         "continuous batching did not share decode steps: "
         f"{summary}")
+
+
+@pytest.mark.slow
+def test_full_load_matrix_paged_shared():
+    """Slow-lane paged leg: the full matrix against the block-paged
+    engine under the shared-prefix workload — parity with the
+    monolithic path plus a real prefix-cache hit rate."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--clients", "8", "--requests", "3",
+         "--slots", "4", "--prompt_len", "12", "--max_new", "8",
+         "--paged", "--block_size", "4", "--prefix_mode", "shared"],
+        env=env, capture_output=True, text=True, timeout=1200, cwd=ROOT)
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, f"no output:\n{out.stdout}\n{out.stderr[-2000:]}"
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = [r for r in rows if r.get("summary")][0]
+    assert summary["ok"] and summary["greedy_parity"] is True
+    paged = [r for r in rows if r.get("mode") == "paged_on"][0]
+    assert paged["prefix_cache_hits"] > 0
+    assert paged["prefills"] < paged["requests"]
