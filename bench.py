@@ -504,21 +504,35 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
         prow = serving_load.run_mode(d, shared, scheduler="on",
                                      prompt_len=prompt_len,
                                      mode_name="paged_shared")
-    admissions = (prow["prefix_cache_hits"]
-                  + prow["prefix_cache_misses"])
-    return {
+    # counters come from the registry snapshot each run_mode captured
+    # (the /metrics exposition = the same atomic snapshot /stats
+    # renders) — not re-derived from response bookkeeping, so the
+    # bench row can never drift from what the server itself reports
+    reg, preg = row["registry"], prow["registry"]
+    decode_steps = int(reg["serving_decode_steps_total"])
+    slot_steps = int(reg["serving_decode_slot_steps_total"])
+    admissions = int(preg["serving_admissions_total"])
+    hits = int(preg.get("serving_prefix_cache_hits_total", 0))
+    out = {
         "serving_tps": row["tokens_per_s"],
         "serving_p95_ms": row["latency_p95_ms"],
-        "serving_decode_steps": row["decode_steps"],
-        "serving_steps_shared": row["steps_shared"],
+        "serving_decode_steps": decode_steps,
+        "serving_steps_shared": round(slot_steps / decode_steps, 3)
+        if decode_steps else 0.0,
         "serving_errors": len(row["errors"]),
         "serving_paged_tps": prow["tokens_per_s"],
-        "serving_prefix_hit_rate": round(
-            prow["prefix_cache_hits"] / admissions, 3)
+        "serving_prefix_hit_rate": round(hits / admissions, 3)
         if admissions else 0.0,
-        "serving_prefill_tokens_saved": prow["prefill_tokens_saved"],
+        "serving_prefill_tokens_saved": int(
+            preg["serving_prefill_tokens_saved_total"]),
         "serving_paged_errors": len(prow["errors"]),
     }
+    # per-request latency breakdown (queue vs prefill vs decode) from
+    # the request-scoped `timings` field — the p95 gate's diagnosis
+    # companion: when p95 moves, this row says WHICH phase moved
+    for phase, pct in row.get("breakdown_ms", {}).items():
+        out[f"serving_{phase}_p95_ms"] = pct["p95"]
+    return out
 
 
 def _long_batch(model, batch, i):

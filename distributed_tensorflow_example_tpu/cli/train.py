@@ -392,6 +392,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "compiled-step flops/bytes to the metrics JSONL "
                         "(WorkerCacheLogger parity; blocks the dispatch "
                         "queue per step)")
+    p.add_argument("--trace_path", default=None,
+                   help="dump the training-loop span lanes (data-wait/"
+                        "step/checkpoint/rollback) as Perfetto-loadable "
+                        "trace-event JSON here when training ends")
+    p.add_argument("--trace_buffer_events", type=int, default=65536,
+                   help="span ring-buffer bound for --trace_path "
+                        "(oldest events drop first)")
     return p
 
 
@@ -500,7 +507,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
             debug_nans=args.debug_nans,
             profile_dir=args.profile_dir,
             profile_steps=profile_steps,
-            step_timing=args.step_timing),
+            step_timing=args.step_timing,
+            trace_path=args.trace_path,
+            trace_buffer_events=args.trace_buffer_events),
     )
 
 

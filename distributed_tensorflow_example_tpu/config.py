@@ -200,6 +200,14 @@ class ObservabilityConfig:
                                       # metrics JSONL (WorkerCacheLogger
                                       # parity, SURVEY.md §2.4/§5.1);
                                       # blocks the dispatch queue per step
+    trace_path: str | None = None     # dump the training-loop trace
+                                      # lanes (data-wait / step /
+                                      # checkpoint / rollback, obs/
+                                      # trace.py) as Perfetto-loadable
+                                      # JSON here when train() ends
+                                      # (chief only)
+    trace_buffer_events: int = 65536  # span ring-buffer bound for the
+                                      # trace above (oldest drop first)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,21 @@
+"""Unified telemetry: metrics registry, Prometheus exposition, tracing.
+
+Three layers (DESIGN.md §14):
+
+- :mod:`.registry` — process-local counters / gauges / fixed-bucket
+  histograms; one lock, atomic snapshots, near-zero disabled path.
+  The serving engine and the trainer both keep their counters HERE,
+  so ``/stats``, ``/metrics``, bench rows, and hook logs read one
+  source of truth.
+- :mod:`.prom` — a snapshot rendered as Prometheus text format
+  (``GET /metrics``).
+- :mod:`.trace` — span API + ring-buffer recorder dumping
+  chrome://tracing / Perfetto trace-event JSON (``POST /trace/start``
+  / ``/trace/stop``); shares its event writer with the offline
+  ``utils/trace_summary.py --chrome`` converter.
+"""
+
+from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
+                       all_registries, merge_snapshots)
+from .trace import (ChromeTraceWriter, TraceRecorder,  # noqa: F401
+                    add_span, recorder, set_recorder, span)

@@ -1,0 +1,82 @@
+"""Prometheus text-format exposition of a registry snapshot.
+
+``GET /metrics`` (serving_http.py) renders the SAME
+:meth:`~.registry.Registry.snapshot` that backs ``/stats`` — the two
+views cannot drift because neither holds its own counters. Output is
+the classic text format (version 0.0.4): ``# HELP`` / ``# TYPE``
+preamble per metric, histogram ``_bucket{le=...}`` series in ascending
+``le`` order ending at ``+Inf``, then ``_sum`` and ``_count``.
+
+Only the snapshot dict comes in — no live registry reference — so the
+renderer can also serve merged snapshots
+(:func:`~.registry.merge_snapshots`) without caring where they came
+from.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt(v: float | int) -> str:
+    """Prometheus number formatting: integers bare, floats via repr
+    (full precision — the /stats consistency contract is exact)."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def _fmt_le(b: float) -> str:
+    """Bucket bounds print without a trailing ``.0`` for whole numbers
+    (``le="1"`` not ``le="1.0"``) — the convention Prometheus's own
+    client libraries follow."""
+    return str(int(b)) if float(b) == int(b) else repr(float(b))
+
+
+def render(snapshot: dict[str, dict[str, Any]]) -> str:
+    """Snapshot -> exposition text. Metric order is sorted by name so
+    the output is deterministic (diffable in tests and scrapes)."""
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        rec = snapshot[name]
+        kind = rec["type"]
+        if rec.get("help"):
+            # escape per the exposition format: backslash then newline
+            h = rec["help"].replace("\\", r"\\").replace("\n", r"\n")
+            lines.append(f"# HELP {name} {h}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind in ("counter", "gauge"):
+            lines.append(f"{name} {_fmt(rec['value'])}")
+            continue
+        acc = 0
+        for bound, count in rec["buckets"]:
+            acc += count
+            lines.append(f'{name}_bucket{{le="{_fmt_le(bound)}"}} {acc}')
+        acc += rec["inf"]
+        lines.append(f'{name}_bucket{{le="+Inf"}} {acc}')
+        lines.append(f"{name}_sum {_fmt(rec['sum'])}")
+        lines.append(f"{name}_count {rec['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def parse(text: str) -> dict[str, float]:
+    """Minimal inverse for tests and the bench row: ``{sample_name ->
+    value}`` including ``_bucket{le=...}`` series keyed with their
+    label (``name_bucket{le="0.5"}``). Not a general parser — it reads
+    exactly what :func:`render` writes."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        out[key] = float(val)
+    return out

@@ -1,0 +1,242 @@
+"""Request-scoped tracing: span API + bounded ring-buffer recorder.
+
+The only tracing the stack had was the *offline* xplane reducer
+(utils/trace_summary.py) — good for "where did the compiled step spend
+device time", useless for "where did THIS slow request spend its
+800 ms" or "is the scheduler starving on prefill vs decode" on a live
+server. This module is the live half:
+
+- :func:`span` — ``with span("prefill", lane="slot0",
+  request_id=rid):`` records one complete event into the process
+  recorder. When tracing is off it returns a shared no-op context
+  manager after a single attribute check: zero allocations, zero
+  recorder calls (the overhead guard in tests/test_obs.py pins this).
+- :class:`TraceRecorder` — a bounded ring buffer (oldest events drop
+  first; ``events_dropped`` counts them) holding (process, lane, name,
+  t0, t1, args) tuples stamped with ``time.perf_counter()``.
+  ``add()`` takes explicit timestamps so retroactive spans work — the
+  scheduler records a request's queue-wait AT admission, from its
+  submit stamp.
+- :class:`ChromeTraceWriter` — the ONE chrome/Perfetto trace-event
+  emitter: process/thread name metadata ("M") events plus complete
+  ("X") events in microseconds. Both this recorder's dump and
+  ``trace_summary.py --chrome`` (the xplane producer) write through
+  it, so the two producers can never disagree on the format.
+
+Lanes are (process, thread) string pairs — e.g. ``("serving",
+"slot3")`` or ``("training", "data")`` — mapped to stable pid/tid
+integers at dump time. The scheduler gives every cache slot its own
+lane so per-slot spans tile without overlapping; Perfetto renders each
+as one row.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+
+class ChromeTraceWriter:
+    """Builds a chrome://tracing / Perfetto trace-event JSON dict.
+
+    Shared by the live recorder and the offline xplane converter: call
+    :meth:`pid` / :meth:`tid` to name processes/threads (metadata
+    events are emitted once per name) and :meth:`complete` per "X"
+    event; :meth:`to_dict` yields the loadable object.
+    """
+
+    def __init__(self):
+        self.events: list[dict[str, Any]] = []
+        self._pids: dict[str, int] = {}
+        self._tids: dict[tuple[int, str], int] = {}
+
+    def pid(self, process_name: str) -> int:
+        p = self._pids.get(process_name)
+        if p is None:
+            p = len(self._pids) + 1
+            self._pids[process_name] = p
+            self.events.append({"ph": "M", "pid": p,
+                                "name": "process_name",
+                                "args": {"name": process_name}})
+        return p
+
+    def tid(self, pid: int, thread_name: str) -> int:
+        key = (pid, thread_name)
+        t = self._tids.get(key)
+        if t is None:
+            t = sum(1 for (p, _) in self._tids if p == pid) + 1
+            self._tids[key] = t
+            self.events.append({"ph": "M", "pid": pid, "tid": t,
+                                "name": "thread_name",
+                                "args": {"name": thread_name}})
+        return t
+
+    def complete(self, *, pid: int, tid: int, name: str, ts_us: float,
+                 dur_us: float, args: dict | None = None) -> None:
+        ev: dict[str, Any] = {"ph": "X", "pid": pid, "tid": tid,
+                              "name": name, "ts": ts_us,
+                              # Perfetto drops true-zero durations
+                              "dur": max(dur_us, 0.001)}
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"traceEvents": self.events, "displayTimeUnit": "ms"}
+
+
+class TraceRecorder:
+    """Bounded in-memory span store. ``start()`` arms it and anchors
+    the timebase; ``stop()`` disarms; ``to_chrome()`` dumps whatever
+    the ring currently holds (callable while armed — a live snapshot).
+    Thread-safe: spans arrive from scheduler/HTTP/trainer threads."""
+
+    def __init__(self, max_events: int = 65536):
+        if max_events < 1:
+            raise ValueError(f"max_events must be >= 1, got {max_events}")
+        self.max_events = max_events
+        self._buf: deque[tuple] = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._t0 = 0.0
+        self.spans_recorded = 0
+        self.events_dropped = 0
+
+    def start(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._t0 = time.perf_counter()
+            self.spans_recorded = 0
+            self.events_dropped = 0
+            self.enabled = True
+
+    def stop(self) -> None:
+        self.enabled = False
+
+    def add(self, process: str, lane: str, name: str, t0: float,
+            t1: float, args: dict | None = None) -> None:
+        """One complete span, ``t0``/``t1`` in ``time.perf_counter()``
+        seconds. Spans that began before ``start()`` are clamped to the
+        capture window (a queue-wait recorded retroactively must not
+        render at negative timestamps)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self.events_dropped += 1
+            self._buf.append((process, lane, name, max(t0, self._t0),
+                              max(t1, self._t0), args))
+            self.spans_recorded += 1
+
+    def to_chrome(self) -> dict[str, Any]:
+        """Ring contents as chrome trace-event JSON (via the shared
+        :class:`ChromeTraceWriter`). Lanes become threads; events sort
+        by timestamp inside the dump so truncated rings still render
+        coherently."""
+        with self._lock:
+            items = sorted(self._buf, key=lambda it: it[3])
+            t0 = self._t0
+            dropped = self.events_dropped
+        w = ChromeTraceWriter()
+        for process, lane, name, s, e, args in items:
+            pid = w.pid(process)
+            tid = w.tid(pid, lane)
+            w.complete(pid=pid, tid=tid, name=name,
+                       ts_us=(s - t0) * 1e6, dur_us=(e - s) * 1e6,
+                       args=args)
+        out = w.to_dict()
+        out["metadata"] = {"events_dropped": dropped,
+                           "max_events": self.max_events}
+        return out
+
+
+class _NoopSpan:
+    """The disabled fast path: one shared instance, enter/exit do
+    nothing. ``span()`` hands this back after a single enabled check —
+    no allocation, no recorder traffic."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_rec", "_process", "_lane", "_name", "_args", "_t0")
+
+    def __init__(self, rec, process, lane, name, args):
+        self._rec = rec
+        self._process = process
+        self._lane = lane
+        self._name = name
+        self._args = args
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.add(self._process, self._lane, self._name, self._t0,
+                      time.perf_counter(), self._args or None)
+        return False
+
+
+# the process recorder: one per process, disabled until someone calls
+# recorder().start() (the POST /trace/start route, a trainer with
+# obs.trace_path, or a test)
+_recorder = TraceRecorder()
+
+
+def recorder() -> TraceRecorder:
+    return _recorder
+
+
+def set_recorder(rec: TraceRecorder) -> TraceRecorder:
+    """Swap the process recorder (the server does this to honor
+    ``--trace_buffer_events``); returns the new one."""
+    global _recorder
+    _recorder = rec
+    return rec
+
+
+def ensure_capacity(max_events: int) -> TraceRecorder:
+    """Resize the process recorder to ``max_events`` — UNLESS a capture
+    is currently armed (another server/trainer in this process owns it;
+    swapping would silently discard its spans). The one owner of this
+    check-then-swap invariant; both ``--trace_buffer_events`` call
+    sites go through it. Returns the (possibly unchanged) recorder."""
+    rec = _recorder
+    if rec.max_events != max_events and not rec.enabled:
+        return set_recorder(TraceRecorder(max_events))
+    return rec
+
+
+def span(name: str, *, process: str = "serving", lane: str = "main",
+         **args):
+    """Context manager recording one complete event on ``(process,
+    lane)``. Extra keyword args (``request_id=...``) land in the
+    event's ``args`` — the request-correlation hook."""
+    rec = _recorder
+    if not rec.enabled:
+        return _NOOP
+    return _LiveSpan(rec, process, lane, name, args)
+
+
+def add_span(name: str, t0: float, t1: float, *, process: str = "serving",
+             lane: str = "main", **args) -> None:
+    """Retroactive span with explicit perf_counter stamps (queue-wait
+    is only known at admission). Same disabled fast path as
+    :func:`span`."""
+    rec = _recorder
+    if not rec.enabled:
+        return
+    rec.add(process, lane, name, t0, t1, args or None)

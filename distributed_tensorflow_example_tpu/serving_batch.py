@@ -39,6 +39,22 @@ Both schedulers enforce a bounded queue: a full queue raises
 is the measured decode-step EMA × estimated steps-to-free
 (:class:`RetryAfterEstimator`), not a queue-depth guess.
 
+Round 11 — unified telemetry: every counter the engine and batcher
+keep now lives in an :class:`~.obs.registry.Registry` (one lock,
+atomic snapshots), so ``GET /stats``, ``GET /metrics`` (Prometheus
+text), and bench rows all read ONE source of truth — the round-9
+``stats()`` race (HTTP threads reading ints the scheduler thread was
+mutating) is gone by construction, and grouped updates
+(``admissions`` moving with its ``hit``/``miss``) are atomic under
+``registry.atomic()``. Each request carries a ``request_id`` from
+HTTP admission to retirement plus a ``timings`` breakdown
+(queue_ms / prefill_ms / decode_ms / tokens) returned in the
+``:generate`` response; the scheduler thread emits per-slot trace
+lanes (queue-wait, prefill, teacher-forced suffix, decode,
+retirement) and scheduler-lane events (admit, decode_step, cow_copy)
+through :mod:`~.obs.trace` — ``POST /trace/start``/``stop`` turn the
+recorder on and dump Perfetto-loadable JSON.
+
 Round 10 — block-paged pool + shared-prefix reuse: with a PAGED
 stepwise artifact (``export_generator(..., paged=True)``) the engine
 swaps the ``slots × T`` slab reservation for a shared pool of
@@ -68,8 +84,12 @@ from collections import OrderedDict, deque
 # writer, streaming decode pool)
 from concurrent.futures import Future
 
+import uuid
+
 import numpy as np
 
+from .obs.registry import Registry
+from .obs.trace import add_span, span
 from .serving import ServableModel, StepwiseGenerator
 
 
@@ -170,15 +190,38 @@ class PrefixCache:
     still mounted by a live slot simply survives its cache eviction.
     """
 
-    def __init__(self, pool: BlockPool, block_size: int):
+    def __init__(self, pool: BlockPool, block_size: int, *,
+                 registry: Registry | None = None):
         self.pool = pool
         self.block_size = block_size
         # key -> (blocks tuple, covered token count); insertion order
         # doubles as LRU (move_to_end on touch)
         self._entries: OrderedDict[bytes, tuple[tuple[int, ...], int]] \
             = OrderedDict()
-        self.hits = 0
-        self.misses = 0
+        # registry-backed counters (the engine hands in ITS registry so
+        # /stats, /metrics and the engine counters stay one source of
+        # truth; standalone unit tests get a private one)
+        self.registry = registry if registry is not None else Registry()
+        self._c_hits = self.registry.counter(
+            "serving_prefix_cache_hits_total",
+            "admissions served (fully or partially) from cached blocks")
+        self._c_misses = self.registry.counter(
+            "serving_prefix_cache_misses_total",
+            "admissions with no cached prefix (cold prefill)")
+
+    @property
+    def hits(self) -> int:
+        return self._c_hits.value
+
+    @property
+    def misses(self) -> int:
+        return self._c_misses.value
+
+    def record_hit(self) -> None:
+        self._c_hits.inc()
+
+    def record_miss(self) -> None:
+        self._c_misses.inc()
 
     @staticmethod
     def _key(tokens: np.ndarray) -> bytes:
@@ -206,10 +249,10 @@ class PrefixCache:
             if e is not None:
                 self._entries.move_to_end(key)
                 if record:
-                    self.hits += 1
+                    self._c_hits.inc()
                 return n, e[0]
         if record:
-            self.misses += 1
+            self._c_misses.inc()
         return 0, ()
 
     def insert(self, tokens: np.ndarray, blocks) -> None:
@@ -310,8 +353,15 @@ class GenRequest:
     seed: int
     eos_id: int | None
     pad_id: int
+    # request-scoped observability: the id travels from HTTP admission
+    # to retirement (response field, trace-span args, JSONL event);
+    # the stamps become the per-request `timings` breakdown
+    request_id: str = ""
     future: Future = dataclasses.field(default_factory=Future)
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
+    t_admit: float = 0.0            # popped from the queue (slot owned)
+    t_first: float = 0.0            # first sampled token emitted
+    timings: dict | None = None     # set just before future resolves
 
     def sampler(self):
         """The per-request host RNG stream: a seeded Philox generator,
@@ -332,6 +382,9 @@ class _Slot:
         self.rng = rng
         self.tokens: list[int] = []
         self.last_tok = 0
+        # span boundaries for this slot's trace lane (perf_counter)
+        self.t_prefill_done = 0.0
+        self.t_forced_done = 0.0
         # paged prefix-reuse admission: KNOWN prompt tokens still to be
         # fed through the shared step (teacher-forced — their logits
         # are discarded until the last one, whose logits are the first
@@ -360,7 +413,9 @@ class GenerationEngine:
     """
 
     def __init__(self, stepwise: StepwiseGenerator, *,
-                 max_queue: int = 64, prefix_cache: bool = True):
+                 max_queue: int = 64, prefix_cache: bool = True,
+                 registry: Registry | None = None,
+                 metrics_logger=None):
         self.sw = stepwise
         m = stepwise.step_meta
         self.slots: int = int(m["slots"])
@@ -386,13 +441,55 @@ class GenerationEngine:
         # the request currently being prefilled (popped from the queue
         # but not yet live) — the fault handler must fail it too
         self._admitting: GenRequest | None = None
-        # stats (all mutated under _cond or by the scheduler thread)
-        self.prefills = 0
-        self.decode_steps = 0
-        self.decode_slot_steps = 0      # sum of live rows over steps
-        self.requests_done = 0
-        self.tokens_out = 0
+        # ---- telemetry: ALL counters live in the registry (one lock,
+        # atomic snapshot) — /stats, /metrics and the legacy attribute
+        # reads below are views of the same values. An optional
+        # MetricsLogger gets one structured JSONL event per retired
+        # request (request_id + timings breakdown).
+        self.registry = registry if registry is not None else Registry(
+            namespace="serving")
+        self.metrics_logger = metrics_logger
+        reg = self.registry
+        self._c_prefills = reg.counter(
+            "serving_prefills_total", "prefill program dispatches")
+        self._c_decode_steps = reg.counter(
+            "serving_decode_steps_total", "shared decode dispatches")
+        self._c_decode_slot_steps = reg.counter(
+            "serving_decode_slot_steps_total",
+            "sum of live slots over decode dispatches")
+        self._c_admissions = reg.counter(
+            "serving_admissions_total",
+            "requests reaching an admission outcome (prefill, "
+            "prefix-cache mount, or loud failure)")
+        self._c_requests_done = reg.counter(
+            "serving_requests_done_total", "requests retired normally")
+        self._c_requests_failed = reg.counter(
+            "serving_requests_failed_total",
+            "requests failed loudly (block exhaustion, engine fault)")
+        self._c_tokens_out = reg.counter(
+            "serving_tokens_out_total", "tokens sampled across requests")
+        self._g_queue_depth = reg.gauge(
+            "serving_queue_depth", "requests waiting for admission")
+        self._g_live_slots = reg.gauge(
+            "serving_live_slots", "cache-pool slots currently decoding")
+        self._h_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "submit-to-retirement request latency")
+        self._h_queue_wait = reg.histogram(
+            "serving_request_queue_seconds",
+            "submit-to-admission queue wait")
+        self._h_prefill = reg.histogram(
+            "serving_request_prefill_seconds",
+            "admission-to-first-sample time (prefill or cached mount + "
+            "teacher-forced suffix)")
+        self._h_decode = reg.histogram(
+            "serving_request_decode_seconds",
+            "first-sample-to-retirement decode time")
         self._latencies: deque[float] = deque(maxlen=2048)
+        # slot-lane bookkeeping: when slot i last freed, so a reused
+        # slot's queue-wait span is clamped to its own tenancy (the
+        # FULL wait is in timings/args — the lane must tile)
+        self._slot_freed_t = [0.0] * self.slots
         self._retry = RetryAfterEstimator()
         # min remaining steps over live slots, refreshed by the
         # scheduler thread after each shared step — a plain float so
@@ -400,16 +497,31 @@ class GenerationEngine:
         self._steps_to_free_hint: float = 1.0
         # ---- block-paged pool state (paged stepwise artifacts) ------
         self.paged: bool = bool(getattr(stepwise, "paged", False))
-        self.prefill_tokens_saved = 0
-        self.cow_copies = 0
+        self._c_tokens_saved = reg.counter(
+            "serving_prefill_tokens_saved_total",
+            "prompt tokens mounted from cached blocks instead of "
+            "prefilled")
+        self._c_cow = reg.counter(
+            "serving_cow_copies_total",
+            "copy-on-write block copies (divergence from a shared "
+            "block)")
         if self.paged:
             self.block_size = int(m["block_size"])
             self.num_blocks = int(m["num_blocks"])
             self.blocks_per_slot = int(m["blocks_per_slot"])
             self.prompt_blocks = int(m["prompt_blocks"])
             self.blocks = BlockPool(self.num_blocks)
+            self._g_blocks_free = reg.gauge(
+                "serving_blocks_free", "free physical cache blocks")
+            self._g_bytes_resident = reg.gauge(
+                "serving_bytes_resident",
+                "bytes of K/V actually resident in allocated blocks")
+            self._g_prefix_entries = reg.gauge(
+                "serving_prefix_cache_entries",
+                "live prefix-cache entries")
             self.prefix_cache = (PrefixCache(self.blocks,
-                                             self.block_size)
+                                             self.block_size,
+                                             registry=reg)
                                  if prefix_cache else None)
             # per-slot block tables, host-owned (the decode program
             # takes them as a per-step operand; 0 = the null block)
@@ -438,11 +550,41 @@ class GenerationEngine:
         return lambda pool, src, dst: copy(pool, np.int32(src),
                                            np.int32(dst))
 
+    # ---- legacy counter views (tests and callers read these as ints;
+    # the registry is the single owner) --------------------------------
+    @property
+    def prefills(self) -> int:
+        return self._c_prefills.value
+
+    @property
+    def decode_steps(self) -> int:
+        return self._c_decode_steps.value
+
+    @property
+    def decode_slot_steps(self) -> int:
+        return self._c_decode_slot_steps.value
+
+    @property
+    def requests_done(self) -> int:
+        return self._c_requests_done.value
+
+    @property
+    def tokens_out(self) -> int:
+        return self._c_tokens_out.value
+
+    @property
+    def prefill_tokens_saved(self) -> int:
+        return self._c_tokens_saved.value
+
+    @property
+    def cow_copies(self) -> int:
+        return self._c_cow.value
+
     # ---- client side -------------------------------------------------
     def _make_request(self, prompt, *, max_new: int | None = None,
                       temperature: float | None = None,
                       top_k: int | None = None, top_p: float | None = None,
-                      seed: int = 0,
+                      seed: int = 0, request_id: str | None = None,
                       eos_id: int | None = ...) -> GenRequest:
         """Validate client inputs into a :class:`GenRequest` — every
         check happens HERE, on the caller's thread, so nothing
@@ -472,7 +614,8 @@ class GenerationEngine:
             top_p=d["top_p"] if top_p is None else float(top_p),
             seed=int(seed),
             eos_id=d["eos_id"] if eos_id is ... else eos_id,
-            pad_id=d["pad_id"])
+            pad_id=d["pad_id"],
+            request_id=request_id or uuid.uuid4().hex[:12])
         if req.temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got "
                              f"{req.temperature}")
@@ -505,6 +648,7 @@ class GenerationEngine:
             # queue so the first admission wave is deterministic); the
             # scheduler drains it once the thread runs
             self._queue.extend(reqs)
+            self._g_queue_depth.set(len(self._queue))
             self._cond.notify_all()
         return [r.future for r in reqs]
 
@@ -519,10 +663,28 @@ class GenerationEngine:
         """Validate EVERY prompt, then queue all of them atomically —
         the multi-row request path (row i samples under ``seed + i``
         so rows stay independent)."""
+        return [r.future for r in self.submit_many_requests(prompts,
+                                                            **kw)]
+
+    def submit_many_requests(self, prompts, *,
+                             request_ids: list[str] | None = None,
+                             **kw) -> list[GenRequest]:
+        """Like :meth:`submit_many` but returns the
+        :class:`GenRequest` objects, whose ``request_id``/``timings``
+        the HTTP layer reads after the future resolves. ``request_ids``
+        (one per prompt) propagates caller-supplied ids (the
+        ``X-Request-Id`` path)."""
+        if request_ids is not None and len(request_ids) != len(prompts):
+            raise ValueError(
+                f"{len(request_ids)} request ids for {len(prompts)} "
+                "prompts")
         seed = kw.pop("seed", 0)
-        reqs = [self._make_request(p, seed=seed + i, **kw)
-                for i, p in enumerate(prompts)]
-        return self._enqueue(reqs)
+        reqs = [self._make_request(
+            p, seed=seed + i,
+            request_id=request_ids[i] if request_ids else None, **kw)
+            for i, p in enumerate(prompts)]
+        self._enqueue(reqs)
+        return reqs
 
     def generate(self, prompt, timeout: float = 300.0, **kw) -> list[int]:
         """Blocking convenience wrapper: submit + wait."""
@@ -563,12 +725,16 @@ class GenerationEngine:
         # than a clear error
         err = RuntimeError("generation engine stopped")
         with self._cond:
+            self._c_requests_failed.inc(len(self._queue)
+                                        + len(self._live))
             for req in self._queue:
                 req.future.set_exception(err)
             self._queue.clear()
+            self._g_queue_depth.set(0)
             for slot in self._live.values():
                 slot.req.future.set_exception(err)
             self._live.clear()
+            self._g_live_slots.set(0)
 
     def _loop(self) -> None:
         while True:
@@ -595,25 +761,26 @@ class GenerationEngine:
                     if self._admitting is not None:
                         self._admitting.future.set_exception(err)
                         self._admitting = None
+                        self._c_requests_failed.inc()
+                    self._c_requests_failed.inc(len(self._live))
                     for slot in self._live.values():
                         slot.req.future.set_exception(err)
                     self._live.clear()
+                    self._g_live_slots.set(0)
                     self._free = list(range(self.slots))[::-1]
                 self._pool = self.sw.make_pool()
                 if self.paged:
                     # the rebuilt pool is empty: every table entry and
                     # cached prefix names bytes that no longer exist
-                    hits, misses = 0, 0
-                    if self.prefix_cache is not None:
-                        hits = self.prefix_cache.hits
-                        misses = self.prefix_cache.misses
+                    # (hit/miss counters live in the engine registry,
+                    # so the rebuilt PrefixCache keeps counting where
+                    # the dead one stopped)
                     self._tables[:] = 0
                     self.blocks = BlockPool(self.num_blocks)
                     if self.prefix_cache is not None:
-                        self.prefix_cache = PrefixCache(self.blocks,
-                                                        self.block_size)
-                        self.prefix_cache.hits = hits
-                        self.prefix_cache.misses = misses
+                        self.prefix_cache = PrefixCache(
+                            self.blocks, self.block_size,
+                            registry=self.registry)
 
     def _admit(self) -> None:
         """Drain the queue into free slots. Runs between shared steps —
@@ -629,7 +796,18 @@ class GenerationEngine:
                     return
                 req = self._queue.popleft()
                 index = self._free.pop()
+                self._g_queue_depth.set(len(self._queue))
                 self._admitting = req
+            req.t_admit = time.perf_counter()
+            # the slot lane shows the tail of the wait spent waiting
+            # for THIS slot (lanes must tile under reuse); the full
+            # wait rides the args and the timings breakdown
+            add_span("queue_wait",
+                     max(req.submitted_at, self._slot_freed_t[index]),
+                     req.t_admit, lane=f"slot{index}",
+                     request_id=req.request_id,
+                     queued_ms=round((req.t_admit - req.submitted_at)
+                                     * 1e3, 3))
             if self.paged:
                 admitted = self._admit_paged(req, index)
             else:
@@ -637,6 +815,7 @@ class GenerationEngine:
                 admitted = True
             with self._cond:
                 self._admitting = None
+                self._g_live_slots.set(len(self._live))
                 if not admitted:
                     return
 
@@ -646,14 +825,19 @@ class GenerationEngine:
         p = req.prompt.size
         ids[0, :p] = req.prompt
         mask[0, :p] = 1
-        out = self.sw.prefill({
-            "input_ids": ids, "prompt_mask": mask,
-            "slot": np.int32(index), **self._pool})
-        self._pool = {"cache_k": out["cache_k"],
-                      "cache_v": out["cache_v"]}
-        self.prefills += 1
+        with span("prefill", lane=f"slot{index}",
+                  request_id=req.request_id, prompt_tokens=p):
+            out = self.sw.prefill({
+                "input_ids": ids, "prompt_mask": mask,
+                "slot": np.int32(index), **self._pool})
+            self._pool = {"cache_k": out["cache_k"],
+                          "cache_v": out["cache_v"]}
+        with self.registry.atomic():
+            self._c_admissions.inc()
+            self._c_prefills.inc()
         slot = _Slot(req, index, pad=int(np.asarray(out["pad"])[0]),
                      pos=self.prompt_len, rng=req.sampler())
+        slot.t_prefill_done = time.perf_counter()
         tok = self._pick(slot, np.asarray(out["logits"])[0])
         self._emit(slot, tok)
 
@@ -670,16 +854,23 @@ class GenerationEngine:
                              if self.prefix_cache is not None
                              else (0, ()))
         if n_hit:
-            self.prefix_cache.hits += 1
             # Cache hit: mount the cached blocks by reference and feed
             # the remaining KNOWN tokens through the shared decode step
             # (teacher-forced). An EXACT whole-prompt hit re-feeds only
             # the last prompt token — its logits are the first sample
             # point, and its write copy-on-writes the shared tail block.
             start = n_hit - 1 if n_hit == p else n_hit
-            self.blocks.retain(hit_blocks)
-            self._tables[index, :len(hit_blocks)] = hit_blocks
+            with span("prefill", lane=f"slot{index}",
+                      request_id=req.request_id, prompt_tokens=p,
+                      cached_tokens=start):
+                self.blocks.retain(hit_blocks)
+                self._tables[index, :len(hit_blocks)] = hit_blocks
+            with self.registry.atomic():
+                self._c_admissions.inc()
+                self.prefix_cache.record_hit()
+                self._c_tokens_saved.inc(start)
             slot = _Slot(req, index, pad=0, pos=start, rng=req.sampler())
+            slot.t_prefill_done = time.perf_counter()
             slot.last_tok = int(tokens[start])
             slot.forced = [int(t) for t in tokens[start + 1:]]
             if n_hit < p:
@@ -688,7 +879,6 @@ class GenerationEngine:
                 # blocks' bytes are decode-computed — same token-level
                 # parity contract as the forcing itself)
                 slot.pending_insert = tokens
-            self.prefill_tokens_saved += start
             self._live[index] = slot
             return True
         # Cold: allocate the prompt's block run (evicting LRU cache
@@ -704,14 +894,20 @@ class GenerationEngine:
                 # retirement will free blocks — try again next boundary
                 with self._cond:
                     self._queue.appendleft(req)
+                    self._g_queue_depth.set(len(self._queue))
                     self._free.append(index)
+                self._slot_freed_t[index] = time.perf_counter()
                 return False
             # nothing live, cache already evicted: the pool simply
             # cannot hold this prompt — fail IT, keep serving
-            if self.prefix_cache is not None:
-                self.prefix_cache.misses += 1
+            with self.registry.atomic():
+                self._c_admissions.inc()
+                self._c_requests_failed.inc()
+                if self.prefix_cache is not None:
+                    self.prefix_cache.record_miss()
             with self._cond:
                 self._free.append(index)
+            self._slot_freed_t[index] = time.perf_counter()
             req.future.set_exception(BlocksExhaustedError(
                 f"prompt of {p} tokens needs {needed} cache blocks but "
                 f"the pool cannot free them: {e}"))
@@ -722,17 +918,23 @@ class GenerationEngine:
         mask = np.zeros((1, self.prompt_len), np.int32)
         ids[0, :p] = tokens
         mask[0, :p] = 1
-        out = self.sw.prefill({
-            "input_ids": ids, "prompt_mask": mask,
-            "table_row": table_row, **self._pool})
-        self._pool = {"cache_k": out["cache_k"],
-                      "cache_v": out["cache_v"]}
-        self.prefills += 1
+        with span("prefill", lane=f"slot{index}",
+                  request_id=req.request_id, prompt_tokens=p):
+            out = self.sw.prefill({
+                "input_ids": ids, "prompt_mask": mask,
+                "table_row": table_row, **self._pool})
+            self._pool = {"cache_k": out["cache_k"],
+                          "cache_v": out["cache_v"]}
+        with self.registry.atomic():
+            self._c_admissions.inc()
+            self._c_prefills.inc()
+            if self.prefix_cache is not None:
+                self.prefix_cache.record_miss()
         self._tables[index, :needed] = run
         if self.prefix_cache is not None:
-            self.prefix_cache.misses += 1
             self.prefix_cache.insert(tokens, run)
         slot = _Slot(req, index, pad=0, pos=p, rng=req.sampler())
+        slot.t_prefill_done = time.perf_counter()
         tok = self._pick(slot, np.asarray(out["logits"])[0])
         self._emit(slot, tok)
         return True
@@ -753,8 +955,11 @@ class GenerationEngine:
         without disturbing its neighbors."""
         self._release_slot_blocks(slot.index)
         del self._live[slot.index]
+        self._c_requests_failed.inc()
         with self._cond:
             self._free.append(slot.index)
+            self._g_live_slots.set(len(self._live))
+        self._slot_freed_t[slot.index] = time.perf_counter()
         slot.req.future.set_exception(err)
 
     def _ensure_write_block(self, slot: _Slot) -> None:
@@ -771,14 +976,20 @@ class GenerationEngine:
                 self.prefix_cache.evict(1)
             self._tables[slot.index, bi] = self.blocks.alloc(1)[0]
         elif self.blocks.refcount(pb) > 1:
-            if self.blocks.free_count < 1 \
-                    and self.prefix_cache is not None:
-                self.prefix_cache.evict(1)
-            nb = self.blocks.alloc(1)[0]
-            self._pool = self._copy_block(self._pool, pb, nb)
-            self._tables[slot.index, bi] = nb
-            self.blocks.release([pb])
-            self.cow_copies += 1
+            # cow spans live on the scheduler lane (they interleave
+            # with the slot's long decode window, and slot lanes must
+            # stay non-overlapping); the request id keeps correlation
+            with span("cow_copy", lane="scheduler",
+                      request_id=slot.req.request_id,
+                      slot=slot.index, block=pb):
+                if self.blocks.free_count < 1 \
+                        and self.prefix_cache is not None:
+                    self.prefix_cache.evict(1)
+                nb = self.blocks.alloc(1)[0]
+                self._pool = self._copy_block(self._pool, pb, nb)
+                self._tables[slot.index, bi] = nb
+                self.blocks.release([pb])
+            self._c_cow.inc()
 
     def _pick(self, slot: _Slot, logits: np.ndarray) -> int:
         """Per-request sampling on the host side of the step boundary
@@ -797,8 +1008,10 @@ class GenerationEngine:
         """Record one sampled token; retire or keep the slot live."""
         slot.tokens.append(tok)
         slot.last_tok = tok
-        self.tokens_out += 1
+        self._c_tokens_out.inc()
         req = slot.req
+        if len(slot.tokens) == 1:
+            req.t_first = time.perf_counter()
         done = (len(slot.tokens) >= req.max_new
                 or (req.eos_id is not None and tok == req.eos_id))
         if done:
@@ -806,15 +1019,62 @@ class GenerationEngine:
             # monolithic while_loop's preallocated pad_id buffer
             toks = slot.tokens + [req.pad_id] * (req.max_new
                                                  - len(slot.tokens))
-            self._latencies.append(time.perf_counter() - req.submitted_at)
-            self.requests_done += 1
+            self._retire(slot, toks)
+        else:
+            self._live[slot.index] = slot
+
+    def _retire(self, slot: _Slot, toks: list[int]) -> None:
+        """Retirement: timings breakdown, spans, counters, slot free,
+        and ONLY THEN the future resolution (a client that wakes on the
+        future must find ``req.timings`` already set)."""
+        req = slot.req
+        t_ret = time.perf_counter()
+        lane = f"slot{slot.index}"
+        # the slot lane tiles: [queue_wait][prefill][forced?][decode][retire]
+        if slot.t_forced_done > slot.t_prefill_done:
+            add_span("forced_suffix", slot.t_prefill_done,
+                     slot.t_forced_done, lane=lane,
+                     request_id=req.request_id)
+        if req.t_first:
+            add_span("decode", max(req.t_first, slot.t_forced_done,
+                                   slot.t_prefill_done), t_ret,
+                     lane=lane, request_id=req.request_id,
+                     tokens=len(slot.tokens))
+        req.timings = {
+            "request_id": req.request_id,
+            "queue_ms": round((req.t_admit - req.submitted_at) * 1e3, 3),
+            "prefill_ms": round((slot.t_prefill_done - req.t_admit)
+                                * 1e3, 3),
+            "decode_ms": round((t_ret - max(slot.t_prefill_done,
+                                            req.t_first or 0.0))
+                               * 1e3, 3),
+            "total_ms": round((t_ret - req.submitted_at) * 1e3, 3),
+            "tokens": len(slot.tokens),
+        }
+        with span("retire", lane=lane, request_id=req.request_id):
             if self.paged:
                 self._release_slot_blocks(slot.index)
             with self._cond:
                 self._free.append(slot.index)
-            req.future.set_result(toks)
-        else:
-            self._live[slot.index] = slot
+                self._g_live_slots.set(len(self._live))
+        self._slot_freed_t[slot.index] = time.perf_counter()
+        # counters BEFORE the future resolves: a client waking on
+        # result() must find requests_done already advanced (tests and
+        # the /stats-vs-/metrics quiesced-equality check read exactly
+        # that way); the µs-scale registry block is not what the
+        # closed-loop client's turnaround feels — the file-I/O request
+        # log below is, so only THAT lands after set_result
+        with self.registry.atomic():
+            self._c_requests_done.inc()
+            self._h_latency.observe(t_ret - req.submitted_at)
+            self._h_queue_wait.observe(req.t_admit - req.submitted_at)
+            self._h_prefill.observe(slot.t_prefill_done - req.t_admit)
+            self._h_decode.observe(t_ret - max(slot.t_prefill_done,
+                                               req.t_first or 0.0))
+        self._latencies.append(t_ret - req.submitted_at)
+        req.future.set_result(toks)
+        if self.metrics_logger is not None:
+            self.metrics_logger.log({"event": "generate", **req.timings})
 
     def _shared_step(self) -> None:
         """ONE batched decode step for every live slot."""
@@ -846,19 +1106,24 @@ class GenerationEngine:
         if self.paged:
             feats["block_tables"] = self._tables
         t0 = time.perf_counter()
-        out = self.sw.decode(feats)
-        self._pool = {"cache_k": out["cache_k"],
-                      "cache_v": out["cache_v"]}
-        logits = np.asarray(out["logits"])   # blocks on the step result
+        with span("decode_step", lane="scheduler",
+                  slots=int(alive.sum())):
+            out = self.sw.decode(feats)
+            self._pool = {"cache_k": out["cache_k"],
+                          "cache_v": out["cache_v"]}
+            logits = np.asarray(out["logits"])   # blocks on the result
         self._retry.observe(time.perf_counter() - t0)
-        self.decode_steps += 1
-        self.decode_slot_steps += len(self._live)
+        with self.registry.atomic():
+            self._c_decode_steps.inc()
+            self._c_decode_slot_steps.inc(len(self._live))
         for i, s in list(self._live.items()):
             s.pos += 1
             if s.forced:
                 # teacher-forced prompt suffix: the next token is
                 # already known — this step's logits are scaffolding
                 s.last_tok = s.forced.pop(0)
+                if not s.forced:
+                    s.t_forced_done = time.perf_counter()
                 continue
             if s.pending_insert is not None and \
                     self.prefix_cache is not None:
@@ -879,23 +1144,51 @@ class GenerationEngine:
             min(s.remaining_steps() for s in live) if live else 1.0)
 
     # ---- observability ----------------------------------------------
-    def stats(self) -> dict:
+    def metrics_snapshot(self) -> dict:
+        """ONE atomic registry snapshot, gauges freshened first — the
+        backing read for both ``/stats`` and ``/metrics`` (so their
+        counter values can never disagree about the same instant, and
+        a concurrent scheduler mutation can never be observed torn:
+        grouped updates hold the registry lock the snapshot takes)."""
+        with self._cond:
+            self._g_queue_depth.set(len(self._queue))
+            self._g_live_slots.set(len(self._live))
+        if self.paged:
+            with self.registry.atomic():
+                free = self.blocks.free_count
+                self._g_blocks_free.set(free)
+                self._g_bytes_resident.set(
+                    (self.blocks.usable - free) * self._block_bytes)
+                if self.prefix_cache is not None:
+                    self._g_prefix_entries.set(len(self.prefix_cache))
+        return self.registry.snapshot()
+
+    def stats(self, snapshot: dict | None = None) -> dict:
+        """The legacy ``/stats`` dict — now a pure VIEW of the registry
+        snapshot (pass one in to share it with a ``/metrics`` render of
+        the same instant)."""
+        snap = self.metrics_snapshot() if snapshot is None else snapshot
         with self._cond:
             lat = list(self._latencies)
-            queue_depth = len(self._queue)
-            live = len(self._live)
-        shared = (self.decode_slot_steps / self.decode_steps
-                  if self.decode_steps else 0.0)
+
+        def c(name):
+            return snap[name]["value"]
+
+        decode_steps = c("serving_decode_steps_total")
+        shared = (c("serving_decode_slot_steps_total") / decode_steps
+                  if decode_steps else 0.0)
         out = {
             "slots": self.slots,
-            "live_slots": live,
-            "queue_depth": queue_depth,
-            "prefills": self.prefills,
-            "decode_steps": self.decode_steps,
-            "decode_slot_steps": self.decode_slot_steps,
+            "live_slots": c("serving_live_slots"),
+            "queue_depth": c("serving_queue_depth"),
+            "admissions": c("serving_admissions_total"),
+            "prefills": c("serving_prefills_total"),
+            "decode_steps": decode_steps,
+            "decode_slot_steps": c("serving_decode_slot_steps_total"),
             "steps_shared": round(shared, 3),
-            "requests_done": self.requests_done,
-            "tokens_out": self.tokens_out,
+            "requests_done": c("serving_requests_done_total"),
+            "requests_failed": c("serving_requests_failed_total"),
+            "tokens_out": c("serving_tokens_out_total"),
             "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
             "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),
@@ -904,23 +1197,24 @@ class GenerationEngine:
             # block-level observability: residency is ACTUAL tokens,
             # not slots × worst-case depth — the paged pool's whole
             # point, so it must be visible at /stats
-            hits = misses = entries = 0
-            if self.prefix_cache is not None:
-                hits = self.prefix_cache.hits
-                misses = self.prefix_cache.misses
-                entries = len(self.prefix_cache)
-            resident = self.blocks.usable - self.blocks.free_count
             out.update({
                 "paged": True,
                 "block_size": self.block_size,
                 "blocks_total": self.blocks.usable,
-                "blocks_free": self.blocks.free_count,
-                "bytes_resident": resident * self._block_bytes,
-                "prefix_cache_hits": hits,
-                "prefix_cache_misses": misses,
-                "prefix_cache_entries": entries,
-                "prefill_tokens_saved": self.prefill_tokens_saved,
-                "cow_copies": self.cow_copies,
+                "blocks_free": c("serving_blocks_free"),
+                "bytes_resident": c("serving_bytes_resident"),
+                "prefix_cache_hits": (
+                    c("serving_prefix_cache_hits_total")
+                    if self.prefix_cache is not None else 0),
+                "prefix_cache_misses": (
+                    c("serving_prefix_cache_misses_total")
+                    if self.prefix_cache is not None else 0),
+                "prefix_cache_entries": (
+                    c("serving_prefix_cache_entries")
+                    if self.prefix_cache is not None else 0),
+                "prefill_tokens_saved": c(
+                    "serving_prefill_tokens_saved_total"),
+                "cow_copies": c("serving_cow_copies_total"),
             })
         return out
 
@@ -941,7 +1235,7 @@ class MicroBatcher:
 
     def __init__(self, servable: ServableModel, *,
                  batch_max_size: int = 8, batch_max_wait_ms: float = 5.0,
-                 max_queue: int = 256):
+                 max_queue: int = 256, registry: Registry | None = None):
         if batch_max_size < 1:
             raise ValueError(f"batch_max_size must be >= 1, got "
                              f"{batch_max_size}")
@@ -961,11 +1255,35 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._running = False
         self._thread: threading.Thread | None = None
-        # stats
-        self.batches = 0
-        self.rows = 0
-        self.padded_rows = 0
+        # stats: registry-owned (shared with the engine's /metrics
+        # page when the server passes its registry in)
+        self.registry = registry if registry is not None else Registry(
+            namespace="serving")
+        self._c_batches = self.registry.counter(
+            "predict_batches_total", "micro-batch dispatches")
+        self._c_rows = self.registry.counter(
+            "predict_rows_total", "client rows served")
+        self._c_padded = self.registry.counter(
+            "predict_padded_rows_total",
+            "bucket-padding rows dispatched beyond client rows")
+        self._g_queue_depth = self.registry.gauge(
+            "predict_queue_depth", "requests waiting for a micro-batch")
+        self._h_latency = self.registry.histogram(
+            "predict_request_latency_seconds",
+            "submit-to-scatter request latency")
         self._latencies: deque[float] = deque(maxlen=2048)
+
+    @property
+    def batches(self) -> int:
+        return self._c_batches.value
+
+    @property
+    def rows(self) -> int:
+        return self._c_rows.value
+
+    @property
+    def padded_rows(self) -> int:
+        return self._c_padded.value
 
     def start(self) -> "MicroBatcher":
         with self._cond:
@@ -1068,26 +1386,35 @@ class MicroBatcher:
             cols = {k: np.concatenate(
                 [v, np.repeat(v[:1], bucket - n_total, axis=0)])
                 for k, v in cols.items()}
-        preds = np.asarray(self.servable(cols))
-        self.batches += 1
-        self.rows += n_total
-        self.padded_rows += bucket - n_total
+        with span("predict_batch", lane="batcher", rows=n_total,
+                  bucket=bucket):
+            preds = np.asarray(self.servable(cols))
+        with self.registry.atomic():
+            self._c_batches.inc()
+            self._c_rows.inc(n_total)
+            self._c_padded.inc(bucket - n_total)
         now = time.perf_counter()
         off = 0
         for feats, n, fut, t0 in taken:
-            fut.set_result(preds[off:off + n])
+            self._h_latency.observe(now - t0)
             self._latencies.append(now - t0)
+            fut.set_result(preds[off:off + n])
             off += n
 
-    def stats(self) -> dict:
+    def metrics_snapshot(self) -> dict:
+        with self._cond:
+            self._g_queue_depth.set(len(self._queue))
+        return self.registry.snapshot()
+
+    def stats(self, snapshot: dict | None = None) -> dict:
+        snap = self.metrics_snapshot() if snapshot is None else snapshot
         with self._cond:
             lat = list(self._latencies)
-            queue_depth = len(self._queue)
         return {
-            "queue_depth": queue_depth,
-            "batches": self.batches,
-            "rows": self.rows,
-            "padded_rows": self.padded_rows,
+            "queue_depth": snap["predict_queue_depth"]["value"],
+            "batches": snap["predict_batches_total"]["value"],
+            "rows": snap["predict_rows_total"]["value"],
+            "padded_rows": snap["predict_padded_rows_total"]["value"],
             "batch_max_size": self.batch_max_size,
             "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),

@@ -58,6 +58,24 @@ race the executable) — the parity oracle the scheduler's byte-identical
 greedy contract is tested against, and the right choice for offline
 correctness work where cross-request batching would only add moving
 parts.
+
+Telemetry (round 11): the server owns ONE
+:class:`~.obs.registry.Registry` shared with its engine/batcher, so
+
+- ``GET /metrics`` serves Prometheus text format rendered from the
+  same atomic snapshot ``/stats`` reads — the two views cannot drift;
+- ``POST /trace/start`` arms the in-process span recorder
+  (``--trace_buffer_events`` bounds the ring) and ``POST /trace/stop``
+  returns the capture as chrome://tracing / Perfetto trace-event JSON
+  (per-slot scheduler lanes, request-ID-correlated);
+- scheduled ``:generate`` responses carry ``request_ids`` and a
+  per-request ``timings`` breakdown (queue_ms / prefill_ms /
+  decode_ms / tokens); a client ``X-Request-Id`` header propagates
+  (row i of a multi-row request gets ``<id>-<i>``), and
+  ``--request_log PATH`` streams one structured JSONL event per
+  retired request through :class:`~.utils.metrics.MetricsLogger`;
+- ``--metrics off`` disables the registry (every increment becomes a
+  single branch) for overhead-sensitive parity work.
 """
 
 from __future__ import annotations
@@ -69,6 +87,9 @@ from typing import Any
 
 import numpy as np
 
+from .obs import prom as obs_prom
+from .obs import trace as obs_trace
+from .obs.registry import Registry
 from .serving import ServableModel, has_stepwise, load_servable
 from .serving_batch import (GenerationEngine, MicroBatcher,
                             QueueFullError)
@@ -96,12 +117,30 @@ class PredictServer:
                  host: str = "127.0.0.1", port: int = 0,
                  scheduler: str = "auto", batch_max_size: int = 8,
                  batch_max_wait_ms: float = 5.0, max_queue: int = 64,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, metrics: bool = True,
+                 trace_buffer_events: int = 65536,
+                 request_log: str | None = None):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
         self.servable: ServableModel = load_servable(export_dir)
         self.name = name or self.servable.meta.get("model", "model")
+        # one registry for the whole server (engine/batcher counters +
+        # the HTTP-level ones below); metrics=False disables every
+        # increment behind a single branch
+        self.registry = Registry(enabled=metrics, namespace="serving")
+        self._c_http_requests = self.registry.counter(
+            "http_requests_total", "HTTP requests handled")
+        self._c_http_errors = self.registry.counter(
+            "http_errors_total", "HTTP responses with status >= 400")
+        self._request_logger = None
+        if request_log:
+            from .utils.metrics import MetricsLogger
+            self._request_logger = MetricsLogger(request_log)
+        # the span recorder is armed via POST /trace/start; the resize
+        # guard (skip when another owner's capture is armed) lives in
+        # obs.trace.ensure_capacity
+        obs_trace.ensure_capacity(trace_buffer_events)
         # the single-flight lock for the direct path: _execute is called
         # from ThreadingHTTPServer handler threads, and nothing else
         # serializes the executable (the scheduler paths serialize by
@@ -129,12 +168,14 @@ class PredictServer:
                 from .serving import load_stepwise
                 self.engine = GenerationEngine(
                     load_stepwise(export_dir), max_queue=max_queue,
-                    prefix_cache=prefix_cache).start()
+                    prefix_cache=prefix_cache, registry=self.registry,
+                    metrics_logger=self._request_logger).start()
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
                     batch_max_wait_ms=batch_max_wait_ms,
-                    max_queue=max_queue).start()
+                    max_queue=max_queue,
+                    registry=self.registry).start()
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
@@ -251,7 +292,8 @@ class PredictServer:
         except Exception as e:
             raise _ServerFault(f"{type(e).__name__}: {e}") from e
 
-    def predict(self, payload: dict) -> dict:
+    def predict(self, payload: dict,
+                request_id: str | None = None) -> dict:
         if self.servable.meta.get("kind") == "generator":
             raise ValueError(
                 "this artifact is a generator — POST to :generate")
@@ -297,13 +339,19 @@ class PredictServer:
                     "(prompt_len in export.json; re-export with a "
                     "larger prompt_len to serve longer prompts)")
 
-    def _generate_scheduled(self, payload: dict) -> dict:
+    def _generate_scheduled(self, payload: dict,
+                            request_id: str | None = None) -> dict:
         """:generate via the continuous-batching engine: each instance
         row becomes one scheduler request (row i of a multi-row request
         samples under ``seed + i`` so rows stay independent). Rows may
         be SHORTER than the exported prompt capacity — the engine
         right-packs ragged prompts natively — and an all-pad
-        ``prompt_mask`` row is rejected like the direct path."""
+        ``prompt_mask`` row is rejected like the direct path.
+
+        Every row gets a request id (the client's ``X-Request-Id``, or
+        an engine-generated one) that travels to retirement; the
+        response carries ``request_ids`` plus the per-request
+        ``timings`` breakdown next to ``generations``."""
         self._check_prompt_lengths(payload)
         rows = None
         if isinstance(payload.get("inputs"), dict):
@@ -367,17 +415,25 @@ class PredictServer:
                                      "least one real token")
                 prompt = prompt[mask != 0]
             prompts.append(prompt)
+        rids = None
+        if request_id:
+            rids = ([request_id] if len(prompts) == 1 else
+                    [f"{request_id}-{i}" for i in range(len(prompts))])
         # submit_many validates EVERY row before queueing ANY, and the
         # enqueue is atomic — a 400/429 on row k must not leave rows
         # 0..k-1 generating for a client that already got an error
-        futures = self.engine.submit_many(prompts, seed=seed, **kw)
+        reqs = self.engine.submit_many_requests(prompts, seed=seed,
+                                                request_ids=rids, **kw)
         try:
-            gens = [f.result(timeout=300) for f in futures]
+            gens = [r.future.result(timeout=300) for r in reqs]
         except (TimeoutError, RuntimeError) as e:
             raise _ServerFault(f"{type(e).__name__}: {e}") from e
-        return {"generations": gens}
+        return {"generations": gens,
+                "request_ids": [r.request_id for r in reqs],
+                "timings": [r.timings for r in reqs]}
 
-    def generate(self, payload: dict) -> dict:
+    def generate(self, payload: dict,
+                 request_id: str | None = None) -> dict:
         """The decode route: ``{"inputs": {"input_ids": [[...]], ...},
         "seed": 7}`` -> ``{"generations": [[token ids]]}``. The ``rng``
         artifact input (present when the artifact samples) is NOT a
@@ -391,7 +447,7 @@ class PredictServer:
                 "this artifact is not a generator — POST to :predict "
                 "(export with export_generator for a decode artifact)")
         if self.engine is not None:
-            return self._generate_scheduled(payload)
+            return self._generate_scheduled(payload, request_id)
         self._check_prompt_lengths(payload)
         sig = {k: v for k, v in self.servable.input_signature.items()
                if k != "rng"}
@@ -461,11 +517,24 @@ class PredictServer:
             def _send(self, code: int, obj: dict,
                       headers: dict | None = None) -> None:
                 body = json.dumps(obj).encode()
+                server._c_http_requests.inc()
+                if code >= 400:
+                    server._c_http_errors.inc()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_text(self, code: int, text: str,
+                           content_type: str) -> None:
+                body = text.encode()
+                server._c_http_requests.inc()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -478,10 +547,20 @@ class PredictServer:
                 elif self.path in ("/stats",
                                    f"/v1/models/{server.name}/stats"):
                     self._send(200, server.stats())
+                elif self.path in ("/metrics",
+                                   f"/v1/models/{server.name}/metrics"):
+                    self._send_text(200, server.metrics_text(),
+                                    obs_prom.CONTENT_TYPE)
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
+                if self.path == "/trace/start":
+                    self._send(200, server.trace_start())
+                    return
+                if self.path == "/trace/stop":
+                    self._send(200, server.trace_stop())
+                    return
                 routes = {f"/v1/models/{server.name}:predict":
                           server.predict,
                           f"/v1/models/{server.name}:generate":
@@ -504,7 +583,9 @@ class PredictServer:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    self._send(200, route(payload))
+                    self._send(200, route(
+                        payload,
+                        self.headers.get("X-Request-Id") or None))
                 except QueueFullError as e:
                     # bounded admission: tell the client WHEN to come
                     # back instead of silently stacking handler threads
@@ -539,18 +620,48 @@ class PredictServer:
         self._thread.start()
         return self
 
+    def _metrics_snapshot(self) -> dict:
+        """The one atomic registry snapshot both /stats and /metrics
+        render — freshened gauges included (engine/batcher share
+        ``self.registry``, so either's snapshot covers everything)."""
+        if self.engine is not None:
+            return self.engine.metrics_snapshot()
+        if self.batcher is not None:
+            return self.batcher.metrics_snapshot()
+        return self.registry.snapshot()
+
+    def metrics_text(self) -> str:
+        """``GET /metrics``: Prometheus text exposition."""
+        return obs_prom.render(self._metrics_snapshot())
+
+    def trace_start(self) -> dict:
+        """``POST /trace/start``: arm the span recorder (clears any
+        previous capture)."""
+        rec = obs_trace.recorder()
+        rec.start()
+        return {"tracing": True, "max_events": rec.max_events}
+
+    def trace_stop(self) -> dict:
+        """``POST /trace/stop``: disarm and return the capture as
+        chrome://tracing / Perfetto trace-event JSON."""
+        rec = obs_trace.recorder()
+        rec.stop()
+        return rec.to_chrome()
+
     def stats(self) -> dict:
         """The /stats payload: scheduler mode plus per-scheduler
         counters (the generate block's ``decode_steps`` /
         ``steps_shared`` are the continuous-batching invariant's
         observable — K concurrent requests should cost ~max(max_new)
-        decode dispatches, not the per-request sum)."""
+        decode dispatches, not the per-request sum). Every counter is
+        a view of the SAME registry snapshot /metrics renders."""
         out: dict[str, Any] = {"model": self.name,
                                "scheduler": self.scheduler}
+        snap = self._metrics_snapshot()
         if self.engine is not None:
-            out["generate"] = self.engine.stats()
+            out["generate"] = self.engine.stats(snap)
         if self.batcher is not None:
-            out["predict"] = self.batcher.stats()
+            out["predict"] = self.batcher.stats(snap)
         return out
 
     def stop(self) -> None:
@@ -562,6 +673,8 @@ class PredictServer:
             self.engine.close()
         if self.batcher is not None:
             self.batcher.close()
+        if self._request_logger is not None:
+            self._request_logger.close()
 
     def __enter__(self) -> "PredictServer":
         return self.start()
@@ -595,13 +708,27 @@ def main(argv=None) -> int:
                     help="paged artifacts only: shared-prefix block "
                     "reuse at admission (off = every prompt prefills "
                     "cold — the shared-vs-cold parity tool)")
+    ap.add_argument("--metrics", choices=("on", "off"), default="on",
+                    help="telemetry registry behind GET /metrics and "
+                    "/stats (off = every counter increment reduces to "
+                    "one branch; /stats serves zeros)")
+    ap.add_argument("--trace_buffer_events", type=int, default=65536,
+                    help="span ring-buffer bound for POST /trace/start"
+                    " captures (oldest events drop first)")
+    ap.add_argument("--request_log", default=None,
+                    help="append one JSONL event per retired :generate "
+                    "request (request_id + queue/prefill/decode ms) "
+                    "to this path")
     args = ap.parse_args(argv)
     srv = PredictServer(args.export_dir, name=args.name, host=args.host,
                         port=args.port, scheduler=args.scheduler,
                         batch_max_size=args.batch_max_size,
                         batch_max_wait_ms=args.batch_max_wait_ms,
                         max_queue=args.max_queue,
-                        prefix_cache=args.prefix_cache == "on")
+                        prefix_cache=args.prefix_cache == "on",
+                        metrics=args.metrics == "on",
+                        trace_buffer_events=args.trace_buffer_events,
+                        request_log=args.request_log)
     print(f"serving {srv.name!r} on http://{args.host}:{srv.port}"
           f"/v1/models/{srv.name}:predict", flush=True)
     srv.serve()

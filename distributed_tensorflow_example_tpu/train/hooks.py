@@ -34,6 +34,7 @@ import jax
 import numpy as np
 
 from ..ckpt.checkpoint import CheckpointManager
+from ..obs.trace import span
 from ..utils.logging import get_logger
 from ..utils.metrics import MetricsLogger, RateTracker
 
@@ -148,9 +149,21 @@ class CheckpointSaverHook(Hook):
             return True
         return False
 
+    def _save(self, trainer, step: int) -> None:
+        """One checkpoint save: span on the trainer's checkpoint trace
+        lane + the registry counter (hooks read the counter through
+        ``trainer.registry`` — get-or-create, so a bare-mock trainer in
+        tests simply skips it)."""
+        with span("checkpoint_save", process="training",
+                  lane="checkpoint", step=step):
+            self.manager.save(trainer.state, step)
+        reg = getattr(trainer, "registry", None)
+        if reg is not None:
+            reg.counter("train_checkpoints_saved_total").inc()
+
     def after_step(self, trainer, step, metrics):
         if self._due(step):
-            self.manager.save(trainer.state, step)
+            self._save(trainer, step)
             self._last_saved_step = step
             self._last_save_t = time.time()
 
@@ -163,7 +176,7 @@ class CheckpointSaverHook(Hook):
         # restore-or-init
         step = int(jax.device_get(trainer.state.step))
         if step != trainer.start_step and self._last_saved_step != step:
-            self.manager.save(trainer.state, step)
+            self._save(trainer, step)
             self._last_saved_step = step
         self.manager.wait()        # async writes must land before exit
 
@@ -228,6 +241,12 @@ class AnomalyPolicyHook(Hook):
         if metrics is None or not self.wants_metrics(step):
             return
         count = int(metrics.get("anomaly_count", 0))
+        reg = getattr(trainer, "registry", None)
+        if reg is not None:
+            # the device-cumulative count, surfaced at the cadence the
+            # metrics were materialized anyway — /metrics-visible
+            # without adding a host sync
+            reg.gauge("train_anomaly_count").set(count)
         if count <= self.observed:
             # every step up to here verified finite: a future rollback
             # must not land past this point, or the anomalous window

@@ -33,6 +33,9 @@ import numpy as np
 from ..ckpt.checkpoint import CheckpointManager, restore_or_init
 from ..config import TrainConfig, anomaly_settings
 from ..data.loader import make_loader
+from ..obs import trace as obs_trace
+from ..obs.registry import Registry
+from ..obs.trace import add_span, span
 from ..parallel.mesh import batch_axis_size, build_mesh
 from ..parallel.sync_replicas import SyncReplicas
 from ..runtime import faults
@@ -106,6 +109,32 @@ class Trainer:
                                  debug_checks=config.obs.debug_checks,
                                  anomaly_policy=config.on_anomaly)
 
+        # telemetry registry (obs/registry.py): the trainer-side
+        # counters live here — hooks reach them through
+        # ``trainer.registry`` (counter() is get-or-create), and the
+        # tier-1 dead-counter lint sees them process-wide. Registered
+        # up front so a run that never checkpoints still EXPOSES the
+        # checkpoint counter at zero instead of hiding it.
+        self.registry = Registry(namespace="training")
+        self._c_steps = self.registry.counter(
+            "train_steps_total", "optimizer steps completed")
+        self._c_ckpt_saves = self.registry.counter(
+            "train_checkpoints_saved_total", "checkpoint saves issued")
+        self._c_rollbacks = self.registry.counter(
+            "train_rollbacks_total",
+            "anomaly rollbacks performed (--on_anomaly rollback)")
+        self._g_anomalies = self.registry.gauge(
+            "train_anomaly_count",
+            "cumulative on-device anomaly count (observed at the "
+            "metrics cadence)")
+        self._h_data_wait = self.registry.histogram(
+            "train_data_wait_seconds",
+            "host time blocked on the data loader per dispatch")
+        self._h_dispatch = self.registry.histogram(
+            "train_dispatch_seconds",
+            "host time to enqueue one step dispatch (async — device "
+            "time only with --step_timing)")
+
         self.ckpt_manager = (
             CheckpointManager(config.checkpoint.directory,
                               max_to_keep=config.checkpoint.max_to_keep,
@@ -115,7 +144,8 @@ class Trainer:
                               sharded=config.checkpoint.sharded)
             if config.checkpoint.directory else None)
         self.metrics_logger = MetricsLogger(config.obs.metrics_path,
-                                            tb_logdir=config.obs.tb_logdir)
+                                            tb_logdir=config.obs.tb_logdir,
+                                            registry=self.registry)
 
         self.process_index = (jax.process_index() if process_index is None
                               else process_index)
@@ -343,6 +373,12 @@ class Trainer:
         self._rollback_pending = False
         fault_reg = faults.active()
         loader = None
+        # --trace_path: arm the span recorder for this train() call and
+        # dump the lanes (data/step/checkpoint/rollback) at teardown
+        trace_path = self.config.obs.trace_path
+        if trace_path:
+            obs_trace.ensure_capacity(
+                self.config.obs.trace_buffer_events).start()
         try:
             # begin() inside the try: a failing begin (or anything after a
             # partial begin) must still run every hook's end() — hooks
@@ -357,7 +393,12 @@ class Trainer:
                 if spl > 1 and remaining >= spl:
                     # K steps per dispatch (iterations_per_loop analogue):
                     # stack K host batches on a leading loop axis and scan
+                    t_d0 = time.perf_counter()
                     stack = [next(loader) for _ in range(spl)]
+                    t_d1 = time.perf_counter()
+                    self._h_data_wait.observe(t_d1 - t_d0)
+                    add_span("data_wait", t_d0, t_d1,
+                             process="training", lane="data", step=step)
                     if fault_reg is not None:
                         # step.* faults poison the HOST batch producing
                         # the matching global step (bad-batch semantics;
@@ -371,10 +412,17 @@ class Trainer:
                         self.sync.precompile(state, batch, multi=True)
                         want_aot = False
                     t0 = time.perf_counter() if timing else 0.0
+                    t_s0 = time.perf_counter()
                     state, device_metrics = self.sync.multi_step(state, batch)
+                    t_s1 = time.perf_counter()
                     step += spl
                 else:
+                    t_d0 = time.perf_counter()
                     host_batch = next(loader)
+                    t_d1 = time.perf_counter()
+                    self._h_data_wait.observe(t_d1 - t_d0)
+                    add_span("data_wait", t_d0, t_d1,
+                             process="training", lane="data", step=step)
                     if fault_reg is not None:
                         host_batch = fault_reg.poison_batch(host_batch,
                                                             step + 1)
@@ -383,8 +431,17 @@ class Trainer:
                         self.sync.precompile(state, batch)
                         want_aot = False
                     t0 = time.perf_counter() if timing else 0.0
+                    t_s0 = time.perf_counter()
                     state, device_metrics = self.sync.step(state, batch)
+                    t_s1 = time.perf_counter()
                     step += 1
+                # dispatch-side span/histogram: host time to ENQUEUE the
+                # step (the loop is async — device time only shows here
+                # under --step_timing, where the block lands below)
+                self._h_dispatch.observe(t_s1 - t_s0)
+                add_span("step_dispatch", t_s0, t_s1,
+                         process="training", lane="step", step=step)
+                self._c_steps.inc(step - step_before)
                 if timing:
                     jax.block_until_ready(state.params)
                     self.last_dispatch_ms = (time.perf_counter() - t0) * 1e3
@@ -450,6 +507,14 @@ class Trainer:
                     log.exception("hook %s end() failed", type(h).__name__)
                     if end_error is None:
                         end_error = e
+            if trace_path:
+                rec = obs_trace.recorder()
+                rec.stop()
+                if jax.process_index() == 0:
+                    with open(trace_path, "w") as f:
+                        json.dump(rec.to_chrome(), f)
+                    log.info("training trace: %s (%d spans)", trace_path,
+                             rec.spans_recorded)
             if end_error is not None and not in_flight:
                 raise end_error
 
@@ -494,6 +559,11 @@ class Trainer:
         step, loader)`` or None when no verified checkpoint exists in
         range (caller halts)."""
         self._rollback_pending = False
+        with span("rollback", process="training", lane="rollback",
+                  at_step=step):
+            return self._perform_rollback_inner(step, old_loader)
+
+    def _perform_rollback_inner(self, step: int, old_loader=None):
         if old_loader is not None and hasattr(old_loader, "close"):
             old_loader.close()      # release the prefetch thread + queue
         before = self._rollback_before
@@ -538,6 +608,7 @@ class Trainer:
             log.warning("rollback: discarded rejected-trajectory "
                         "checkpoint step(s) %s", discarded)
         loader = self._loader(start_step=target)
+        self._c_rollbacks.inc()
         log.warning("rollback: restored verified checkpoint step %d "
                     "(training was at step %d); data stream "
                     "fast-forwarded to match", target, step)

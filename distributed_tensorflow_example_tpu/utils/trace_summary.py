@@ -192,6 +192,8 @@ def chrome_trace(trace_dir: str, *,
     directly. ``max_events_per_line`` truncates pathologically dense
     lines (the longest captures carry hundreds of thousands of events).
     """
+    from ..obs.trace import ChromeTraceWriter
+
     spaces = _load_xspaces(trace_dir) if spaces is None else spaces
     bases = [line.timestamp_ns * 1000                     # ns -> ps
              for _, xs in spaces for plane in xs.planes
@@ -200,20 +202,26 @@ def chrome_trace(trace_dir: str, *,
         raise RuntimeError("no planes with events found in the capture")
     t0_ps = min(bases)
 
-    events: list[dict[str, Any]] = []
-    pid = 0
+    # one emitter, two producers: the live scheduler/trainer span
+    # recorder (obs/trace.py) and this offline xplane converter both
+    # write through ChromeTraceWriter, so the event format (metadata
+    # "M" naming + complete "X" events in µs) can never fork
+    w = ChromeTraceWriter()
     for fname, xs in spaces:
         for plane in xs.planes:
             if not plane.lines:
                 continue
-            pid += 1
-            events.append({"ph": "M", "pid": pid, "name": "process_name",
-                           "args": {"name": f"{fname}:{plane.name}"}})
+            pid = w.pid(f"{fname}:{plane.name}")
             meta = _metadata_map(plane)
-            for tid, line in enumerate(plane.lines, start=1):
-                events.append({"ph": "M", "pid": pid, "tid": tid,
-                               "name": "thread_name",
-                               "args": {"name": line.name}})
+            seen: dict[str, int] = {}
+            for line in plane.lines:
+                # thread-pool captures repeat line names; the writer
+                # keys lanes BY name, so duplicates must be suffixed or
+                # two real threads would collapse onto one lane
+                k = seen.get(line.name, 0)
+                seen[line.name] = k + 1
+                lname = f"{line.name} #{k + 1}" if k else line.name
+                tid = w.tid(pid, lname)
                 line_events = line.events
                 if max_events_per_line is not None:
                     line_events = sorted(
@@ -222,16 +230,15 @@ def chrome_trace(trace_dir: str, *,
                 base_ps = line.timestamp_ns * 1000 - t0_ps
                 for ev in line_events:
                     full = meta.get(ev.metadata_id, "?")
-                    events.append({
-                        "ph": "X", "pid": pid, "tid": tid,
-                        # HLO event names are whole instruction texts;
-                        # the defining op name is the readable label
-                        "name": _defining_name(full)[:120],
-                        "ts": (base_ps + ev.offset_ps) / 1e6,  # ps -> us
-                        "dur": max(ev.duration_ps / 1e6, 0.001),
-                        "args": {"full_name": full[:400]},
-                    })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+                    # HLO event names are whole instruction texts; the
+                    # defining op name is the readable label
+                    w.complete(
+                        pid=pid, tid=tid,
+                        name=_defining_name(full)[:120],
+                        ts_us=(base_ps + ev.offset_ps) / 1e6,  # ps->us
+                        dur_us=ev.duration_ps / 1e6,
+                        args={"full_name": full[:400]})
+    return w.to_dict()
 
 
 def format_text(summary: dict[str, Any]) -> str:

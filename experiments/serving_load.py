@@ -38,12 +38,23 @@ Usage::
     python experiments/serving_load.py --paged --block_size 16 \
         --prompt_len 64 --prefix_mode shared
 
+Round 11 — telemetry: scheduler-on rows additionally carry a
+``breakdown_ms`` block (queue-wait vs prefill vs decode p50/p95/p99,
+from the per-request ``timings`` field every scheduled ``:generate``
+response now returns) and a ``registry`` block (the ``GET /metrics``
+Prometheus exposition parsed back — the SAME atomic snapshot ``/stats``
+renders; ``run_mode`` asserts the two agree exactly once the matrix is
+quiesced, and ``bench.py`` sources its serving counters from it). The
+``--smoke`` paged-shared leg runs under ``POST /trace/start``/``stop``
+and validates the captured Perfetto timeline (per-slot prefill/decode
+spans, request-id correlation).
+
 Prints one JSON line per mode plus a ``summary`` line. ``--smoke`` is
 the tier-1 CPU configuration (2 clients, tiny model) and ALSO runs the
 paged cold/shared legs, asserting paged-vs-slab byte parity,
-shared-vs-cold admission byte parity, and shared-mode prefill
-dispatches strictly below cold-mode; the full matrix is registered as
-a ``slow`` test (tests/test_serving_load.py).
+shared-vs-cold admission byte parity, shared-mode prefill dispatches
+strictly below cold-mode, and the scheduler-trace capture; the full
+matrix is registered as a ``slow`` test (tests/test_serving_load.py).
 """
 
 import argparse
@@ -73,6 +84,55 @@ def _stats(port):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats",
                                 timeout=30) as r:
         return json.loads(r.read())
+
+
+def _prom(port):
+    """GET /metrics parsed into {sample_name: value} — the registry
+    snapshot in Prometheus clothing; the bench row sources its
+    counters from THIS instead of re-deriving them."""
+    from distributed_tensorflow_example_tpu.obs import prom
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                timeout=30) as r:
+        return prom.parse(r.read().decode())
+
+
+def _trace(port, verb):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/trace/{verb}",
+                                 data=b"{}")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _validate_trace(tr, want_request_ids):
+    """A captured scheduler trace must be loadable chrome trace-event
+    JSON: complete events carry ts/dur/pid/tid/name, per-slot lanes
+    exist with prefill/decode spans, and every served request's id
+    appears in span args. Returns the X-event count."""
+    xs = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "trace captured no spans"
+    for e in xs:
+        for k in ("ts", "dur", "pid", "tid", "name"):
+            assert k in e, f"X event missing {k}: {e}"
+    lanes = {e["args"]["name"] for e in tr["traceEvents"]
+             if e.get("name") == "thread_name"}
+    assert any(ln.startswith("slot") for ln in lanes), lanes
+    names = {e["name"] for e in xs}
+    assert {"prefill", "decode_step", "queue_wait", "retire"} <= names, \
+        sorted(names)
+    span_rids = {e["args"]["request_id"] for e in xs
+                 if e.get("args", {}).get("request_id")}
+    missing = set(want_request_ids) - span_rids
+    assert not missing, f"request ids absent from trace: {missing}"
+    return len(xs)
+
+
+def _pctls(samples_ms):
+    """{p50,p95,p99} of a millisecond sample list (zeros when empty) —
+    the same nearest-rank rule /stats uses."""
+    from distributed_tensorflow_example_tpu.serving_batch import \
+        percentile
+    return {f"p{q}": round(percentile(samples_ms, q), 2)
+            for q in (50, 95, 99)}
 
 
 def build_export(out_dir: str, *, prompt_len: int, max_new: int,
@@ -145,7 +205,7 @@ def make_requests(clients: int, requests: int, *, prompt_len: int,
 
 def run_mode(export_dir: str, matrix, *, scheduler: str,
              prompt_len: int, mode_name: str | None = None,
-             prefix_cache: bool = True) -> dict:
+             prefix_cache: bool = True, trace: bool = False) -> dict:
     """Drive one server mode with the closed-loop client matrix;
     returns the result row (and stashes per-request generations under
     ``_gens`` for the parity check)."""
@@ -154,6 +214,8 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     clients = len(matrix)
     lat: list[list[float]] = [[] for _ in range(clients)]
     gens: list[list[list[int]]] = [[] for _ in range(clients)]
+    timings: list[dict] = []             # scheduler on: one per request
+    request_ids: list[str] = []
     errors: list[str] = []
     with PredictServer(export_dir, scheduler=scheduler,
                        prefix_cache=prefix_cache) as srv:
@@ -181,7 +243,12 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
                     return
                 lat[ci].append(time.perf_counter() - t0)
                 gens[ci].append(out["generations"][0][:m])
+                if "timings" in out:
+                    timings.extend(out["timings"])
+                    request_ids.extend(out["request_ids"])
 
+        if trace:
+            _trace(srv.port, "start")
         t_start = time.perf_counter()
         threads = [threading.Thread(target=client, args=(ci,))
                    for ci in range(clients)]
@@ -191,6 +258,11 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
             t.join()
         wall = time.perf_counter() - t_start
         stats = _stats(srv.port)
+        registry = _prom(srv.port) if scheduler == "on" else {}
+        trace_events = None
+        if trace:
+            trace_events = _validate_trace(_trace(srv.port, "stop"),
+                                           request_ids)
 
     flat_lat = sorted(x for row in lat for x in row)
     n_req = len(flat_lat)
@@ -203,6 +275,20 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
         return flat_lat[i] * 1e3
 
     g = stats.get("generate", {})
+    if registry:
+        # /stats is a view of the registry snapshot /metrics renders —
+        # with the server quiesced (all closed-loop clients joined) the
+        # two must agree EXACTLY; a mismatch means the one-source-of-
+        # truth contract broke
+        for stat_key, prom_key in (
+                ("decode_steps", "serving_decode_steps_total"),
+                ("prefills", "serving_prefills_total"),
+                ("requests_done", "serving_requests_done_total"),
+                ("tokens_out", "serving_tokens_out_total")):
+            if g.get(stat_key) != registry.get(prom_key):
+                errors.append(
+                    f"/stats {stat_key}={g.get(stat_key)} disagrees "
+                    f"with /metrics {prom_key}={registry.get(prom_key)}")
     row = {
         "mode": mode_name or f"scheduler_{scheduler}",
         "clients": clients,
@@ -220,6 +306,23 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
         "steps_shared": g.get("steps_shared", 1.0),
         "_gens": gens,
     }
+    if timings:
+        # per-request latency breakdown from the engine's `timings`
+        # field: WHERE the time went (admission queue vs prefill vs
+        # shared decode), not just how much there was
+        row["breakdown_ms"] = {
+            "queue": _pctls([t["queue_ms"] for t in timings]),
+            "prefill": _pctls([t["prefill_ms"] for t in timings]),
+            "decode": _pctls([t["decode_ms"] for t in timings]),
+        }
+    if registry:
+        # the registry snapshot itself (counters/gauges only — bucket
+        # series stay on /metrics): bench.py sources its serving
+        # counters from here instead of re-deriving them
+        row["registry"] = {k: v for k, v in sorted(registry.items())
+                           if "_bucket{" not in k}
+    if trace_events is not None:
+        row["trace_events"] = trace_events
     if g.get("paged"):
         row.update({
             "prefix_cache_hits": g["prefix_cache_hits"],
@@ -315,9 +418,14 @@ def main(argv=None) -> int:
                                       prompt_len=args.prompt_len,
                                       mode_name="paged_cold")
                 shared = matrix_for(vocab, "shared")
+                # trace=True: the smoke run doubles as the scheduler-
+                # timeline gate — the captured Perfetto JSON is
+                # validated (per-slot prefill/decode spans, request-id
+                # correlation) inside run_mode
                 paged_shared = run_mode(dp, shared, scheduler="on",
                                         prompt_len=args.prompt_len,
-                                        mode_name="paged_shared")
+                                        mode_name="paged_shared",
+                                        trace=True)
                 shared_off = run_mode(dp, shared, scheduler="off",
                                       prompt_len=args.prompt_len,
                                       mode_name="shared_off")
@@ -329,6 +437,8 @@ def main(argv=None) -> int:
                  paged_shared["_gens"] == shared_off["_gens"]),
                 ("shared_prefills_below_cold",
                  paged_shared["prefills"] < paged_cold["prefills"]),
+                ("scheduler_trace_valid",
+                 paged_shared.get("trace_events", 0) > 0),
             ]
 
     parity = None

@@ -44,14 +44,51 @@ def load_known_failures() -> list[str]:
                 if ln.strip() and not ln.startswith("#")]
 
 
+def build_telemetry_summary() -> str:
+    """One-line tier-1 telemetry summary + dead-counter lint. A metric
+    name counts as exercised when ANY registry instance of it was ever
+    mutated this process (each engine/trainer owns its own registry —
+    the accumulator outlives them); a name nothing ever touched is a
+    DEAD counter — tests are silent about metrics that exist but are
+    never incremented, so this banner is the only place that gap
+    shows up. Only namespaced (production) registries contribute, so
+    unit-test probe registries can't pollute the line."""
+    from distributed_tensorflow_example_tpu.obs.registry import \
+        process_metric_names
+    names = process_metric_names()
+    if not names:
+        return ""
+    dead = sorted(n for n, touched in names.items() if not touched)
+    line = (f"TELEMETRY: {len(names)} registry metric(s) seen, "
+            f"{len(names) - len(dead)} exercised")
+    if dead:
+        line += (f", {len(dead)} DEAD (registered but never "
+                 f"incremented by the suite): {dead}")
+    else:
+        line += ", 0 dead"
+    return line
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
-    """Known-failure-set drift banner: tier-1 carries a documented
-    pre-existing failure set (docs/known_failures.txt); any failure
-    NOT on that list is flagged here by name so a fresh regression can
-    never hide inside the known-bad count (see
-    tests/test_known_failures_guard.py for the companion re-run
-    guard). Print-only — the run's exit status already reflects the
-    failures themselves."""
+    """Known-failure-set drift banner + tier-1 telemetry summary.
+
+    Drift: tier-1 carries a documented pre-existing failure set
+    (docs/known_failures.txt); any failure NOT on that list is flagged
+    here by name so a fresh regression can never hide inside the
+    known-bad count (see tests/test_known_failures_guard.py for the
+    companion re-run guard). Print-only — the run's exit status
+    already reflects the failures themselves.
+
+    Telemetry: one line naming registry metrics the whole suite never
+    incremented (the dead-counter lint — see
+    ``build_telemetry_summary``)."""
+    try:
+        tele = build_telemetry_summary()
+    except Exception:           # the lint must never mask test results
+        tele = ""
+    if tele:
+        terminalreporter.section("TIER-1 TELEMETRY", sep="-")
+        terminalreporter.line(tele)
     failed = [r.nodeid for r in terminalreporter.stats.get("failed", [])]
     if not failed:
         return

@@ -14,15 +14,44 @@ guards close it:
 - the conftest ``pytest_terminal_summary`` hook prints a
   ``KNOWN-FAILURE-SET DRIFT`` banner whenever a tier-1 run fails a
   test that is NOT on the list.
+
+The same conftest banner path also prints a one-line TIER-1 TELEMETRY
+summary with a dead-counter lint: an obs-registry metric every test in
+the suite left untouched is named there — tests are silent about
+counters that exist but are never incremented, so the banner is where
+that rot becomes visible (see ``conftest.build_telemetry_summary``).
 """
 
 import os
 import subprocess
 import sys
 
-from conftest import load_known_failures
+from conftest import build_telemetry_summary, load_known_failures
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_telemetry_summary_counts_dead_metrics():
+    """The dead-counter lint sees every registry in the process and
+    names exactly the metrics nothing ever mutated — exercised-
+    anywhere wins over dead-somewhere (each engine registers its own
+    copy of a name)."""
+    from distributed_tensorflow_example_tpu.obs.registry import Registry
+    r1 = Registry(namespace="lintprobe")
+    r2 = Registry(namespace="lintprobe")
+    r1.counter("lint_probe_dead_total")
+    r1.counter("lint_probe_live_total").inc()
+    # same name dead in r2 but touched in r1 -> exercised overall
+    r2.counter("lint_probe_live_total")
+    # un-namespaced registries are test scaffolding: never in the line
+    Registry().counter("lint_probe_scaffold_total")
+    line = build_telemetry_summary()
+    assert line.startswith("TELEMETRY: ")
+    assert "lint_probe_dead_total" in line
+    assert "lint_probe_live_total" not in line
+    assert "lint_probe_scaffold_total" not in line
+    r1.counter("lint_probe_dead_total").inc()       # now exercised
+    assert "lint_probe_dead_total" not in build_telemetry_summary()
 
 
 def test_known_failure_set_is_stable():

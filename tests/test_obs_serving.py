@@ -1,0 +1,317 @@
+"""Telemetry integration over the serving stack (round 11):
+
+- the /stats snapshot-race regression: concurrent load + hammered
+  stats reads never observe torn invariants (hits + misses ==
+  admissions; prefills <= admissions),
+- GET /metrics is valid Prometheus text whose counters agree with the
+  /stats view of the same registry (invariants under load, exact
+  equality once quiesced),
+- POST /trace/start|stop captures a Perfetto-loadable scheduler
+  timeline: every X event carries ts/dur/pid/tid/name, per-slot lanes
+  tile without overlap, and a request's spans carry its request id,
+- :generate responses return request_ids + a timings breakdown
+  (queue/prefill/decode/tokens), X-Request-Id propagates, and
+  --request_log streams one JSONL event per retired request,
+- the disabled-telemetry fast path: a full engine run with tracing
+  off records ZERO spans, and a metrics=False server's counters never
+  move.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import TrainConfig
+from distributed_tensorflow_example_tpu.models import get_model
+from distributed_tensorflow_example_tpu.obs import prom
+from distributed_tensorflow_example_tpu.obs.trace import recorder
+from distributed_tensorflow_example_tpu.serving import (export_generator,
+                                                        load_stepwise)
+from distributed_tensorflow_example_tpu.serving_batch import GenerationEngine
+from distributed_tensorflow_example_tpu.serving_http import PredictServer
+
+PROMPT_LEN = 8
+MAX_NEW = 5
+SLOTS = 4
+BLOCK = 4
+
+
+@pytest.fixture(scope="module")
+def paged_dir(tmp_path_factory):
+    """One paged stepwise export shared module-wide (the paged engine
+    carries the richest counter set: prefix cache, blocks, COW)."""
+    d = str(tmp_path_factory.mktemp("paged"))
+    m = get_model("gpt_tiny", TrainConfig(model="gpt_tiny"))
+    params = m.init(jax.random.key(0))
+    export_generator(m, params, d, prompt_len=PROMPT_LEN,
+                     max_new_tokens=MAX_NEW, batch_size=1, ragged=True,
+                     stepwise=True, slots=SLOTS, paged=True,
+                     block_size=BLOCK, platforms=("cpu",))
+    return d
+
+
+def _prompts(n, seed=0, shared_prefix=None):
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        p = int(rs.randint(1, PROMPT_LEN + 1))
+        row = rs.randint(0, 1000, (p,)).astype(np.int32)
+        if shared_prefix is not None:
+            row = np.concatenate(
+                [shared_prefix, row])[:PROMPT_LEN].astype(np.int32)
+        out.append(row)
+    return out
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.read()
+
+
+def _post(port, path, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def _assert_invariants(s):
+    """The torn-read detectors: every relation here is maintained under
+    registry.atomic() groups, so NO interleaving of the scheduler
+    thread and a stats reader may ever break one."""
+    assert s["prefix_cache_hits"] + s["prefix_cache_misses"] \
+        == s["admissions"], s
+    assert s["prefills"] <= s["admissions"], s
+    assert s["requests_done"] + s["requests_failed"] \
+        <= s["admissions"], s
+    assert s["decode_slot_steps"] >= s["decode_steps"] or \
+        s["decode_steps"] == 0, s
+
+
+def test_stats_snapshot_race_regression(paged_dir):
+    """Concurrent load + a stats-hammering thread: every read is one
+    atomic registry snapshot, so the grouped invariants hold in ALL of
+    them (the round-9 implementation read live ints mid-mutation)."""
+    eng = GenerationEngine(load_stepwise(paged_dir)).start()
+    stop = threading.Event()
+    bad = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                _assert_invariants(eng.stats())
+            except AssertionError as e:
+                bad.append(str(e))
+                return
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        sp = np.arange(BLOCK, dtype=np.int32)       # shared prefix -> hits
+        futs = [eng.submit(p) for p in
+                _prompts(6, seed=3) + _prompts(6, seed=4,
+                                               shared_prefix=sp)]
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        stop.set()
+        t.join()
+        eng.close()
+    assert not bad, bad[0]
+    s = eng.stats()
+    _assert_invariants(s)
+    assert s["admissions"] == 12
+    assert s["requests_done"] == 12
+
+
+def test_metrics_endpoint_consistent_with_stats(paged_dir):
+    """GET /metrics under concurrent load: parses as Prometheus text,
+    invariants hold within each scrape, and once quiesced the counter
+    values equal the /stats view EXACTLY (same registry snapshot)."""
+    with PredictServer(paged_dir, scheduler="on") as srv:
+        stop = threading.Event()
+        bad = []
+
+        def scrape():
+            while not stop.is_set():
+                p = prom.parse(_get(srv.port, "/metrics").decode())
+                try:
+                    assert (p["serving_prefix_cache_hits_total"]
+                            + p["serving_prefix_cache_misses_total"]
+                            == p["serving_admissions_total"]), p
+                    assert p["serving_prefills_total"] \
+                        <= p["serving_admissions_total"], p
+                except AssertionError as e:
+                    bad.append(str(e))
+                    return
+
+        t = threading.Thread(target=scrape)
+        t.start()
+        try:
+            rows = [p.tolist() for p in _prompts(8, seed=5)]
+            threads = [threading.Thread(target=_post, args=(
+                srv.port, f"/v1/models/{srv.name}:generate",
+                {"inputs": {"input_ids": [r]}})) for r in rows]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        finally:
+            stop.set()
+            t.join()
+        assert not bad, bad[0]
+
+        text = _get(srv.port, "/metrics").decode()
+        parsed = prom.parse(text)
+        stats = json.loads(_get(srv.port, "/stats"))
+        g = stats["generate"]
+        for stat_key, prom_key in (
+                ("admissions", "serving_admissions_total"),
+                ("prefills", "serving_prefills_total"),
+                ("decode_steps", "serving_decode_steps_total"),
+                ("requests_done", "serving_requests_done_total"),
+                ("tokens_out", "serving_tokens_out_total"),
+                ("prefix_cache_hits",
+                 "serving_prefix_cache_hits_total"),
+                ("cow_copies", "serving_cow_copies_total")):
+            assert g[stat_key] == parsed[prom_key], (
+                f"/stats {stat_key}={g[stat_key]} != /metrics "
+                f"{prom_key}={parsed[prom_key]}")
+        # exposition shape: TYPE lines + histogram series complete
+        assert "# TYPE serving_admissions_total counter" \
+            in text.splitlines()
+        assert "serving_request_latency_seconds_count" in parsed
+        assert 'serving_request_latency_seconds_bucket{le="+Inf"}' \
+            in parsed
+
+
+def test_trace_endpoints_capture_scheduler_timeline(paged_dir):
+    """POST /trace/start -> load (shared prefixes force forced-suffix
+    + COW spans) -> POST /trace/stop: valid chrome trace-event JSON,
+    complete events well-formed, slot lanes non-overlapping, request
+    ids correlated with the :generate responses."""
+    with PredictServer(paged_dir, scheduler="on") as srv:
+        r = _post(srv.port, "/trace/start", {})
+        assert r["tracing"] is True
+        # deterministic shared-prefix pair: the second prompt mounts
+        # the first's full-block prefix and teacher-forces its 3-token
+        # suffix — guaranteeing forced_suffix (and COW) spans
+        rows = ([p.tolist() for p in _prompts(4, seed=7)]
+                + [[1, 2, 3, 4, 10, 11, 12, 13],
+                   [1, 2, 3, 4, 20, 21, 22, 23]])
+        outs = [_post(srv.port, f"/v1/models/{srv.name}:generate",
+                      {"inputs": {"input_ids": [r_]}}) for r_ in rows]
+        trace = _post(srv.port, "/trace/stop", {})
+
+    assert json.loads(json.dumps(trace))         # serializable
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert xs, "no spans captured"
+    for e in xs:
+        for k in ("ts", "dur", "pid", "tid", "name"):
+            assert k in e, f"X event missing {k}: {e}"
+        assert e["dur"] > 0 and e["ts"] >= 0
+
+    # lane naming: thread-metadata maps (pid, tid) -> lane name
+    lanes = {(e["pid"], e["tid"]): e["args"]["name"]
+             for e in events if e.get("name") == "thread_name"}
+    assert "scheduler" in lanes.values()
+    slot_lanes = [k for k, v in lanes.items() if v.startswith("slot")]
+    assert slot_lanes, f"no per-slot lanes in {sorted(lanes.values())}"
+
+    # per-slot lanes tile: spans on one lane never overlap (1µs slack
+    # for float rounding at the boundaries)
+    for lane_key in slot_lanes:
+        spans = sorted((e for e in xs
+                        if (e["pid"], e["tid"]) == lane_key),
+                       key=lambda e: e["ts"])
+        for a, b in zip(spans, spans[1:]):
+            assert a["ts"] + a["dur"] <= b["ts"] + 1.0, (
+                f"overlap on {lanes[lane_key]}: {a} then {b}")
+
+    # the span vocabulary the scheduler promises
+    names = {e["name"] for e in xs}
+    for want in ("queue_wait", "prefill", "decode", "retire",
+                 "decode_step"):
+        assert want in names, f"missing {want!r} in {sorted(names)}"
+    assert "forced_suffix" in names     # the shared-prefix admissions
+
+    # request-id correlation: every response id appears on its spans,
+    # and each correlated request has the full lifecycle span set
+    span_rids = {e["args"]["request_id"] for e in xs
+                 if e.get("args", {}).get("request_id")}
+    for out in outs:
+        rid = out["request_ids"][0]
+        assert rid in span_rids, f"{rid} absent from trace"
+        mine = {e["name"] for e in xs
+                if e.get("args", {}).get("request_id") == rid}
+        assert {"queue_wait", "retire"} <= mine, (rid, mine)
+
+
+def test_generate_timings_and_request_id_propagation(paged_dir):
+    with PredictServer(paged_dir, scheduler="on") as srv:
+        out = _post(srv.port, f"/v1/models/{srv.name}:generate",
+                    {"inputs": {"input_ids": [[1, 2, 3], [4, 5]]}},
+                    headers={"X-Request-Id": "trace-me"})
+        assert out["request_ids"] == ["trace-me-0", "trace-me-1"]
+        assert len(out["timings"]) == 2
+        for i, t in enumerate(out["timings"]):
+            assert t["request_id"] == f"trace-me-{i}"
+            assert t["tokens"] == len([x for x in out["generations"][i]
+                                       if True][:t["tokens"]])
+            assert t["queue_ms"] >= 0 and t["prefill_ms"] >= 0 \
+                and t["decode_ms"] >= 0
+            assert t["total_ms"] >= max(t["queue_ms"], t["prefill_ms"],
+                                        t["decode_ms"])
+        # no header -> engine-generated ids, still unique + present
+        out2 = _post(srv.port, f"/v1/models/{srv.name}:generate",
+                     {"inputs": {"input_ids": [[7, 8], [9]]}})
+        assert len(set(out2["request_ids"])) == 2
+
+
+def test_request_log_jsonl_events(paged_dir, tmp_path):
+    log_path = str(tmp_path / "requests.jsonl")
+    with PredictServer(paged_dir, scheduler="on",
+                       request_log=log_path) as srv:
+        _post(srv.port, f"/v1/models/{srv.name}:generate",
+              {"inputs": {"input_ids": [[1, 2, 3], [4, 5, 6]]}},
+              headers={"X-Request-Id": "logged"})
+    with open(log_path) as f:
+        recs = [json.loads(ln) for ln in f]
+    assert len(recs) == 2
+    assert {r["request_id"] for r in recs} == {"logged-0", "logged-1"}
+    for r in recs:
+        assert r["event"] == "generate"
+        for k in ("queue_ms", "prefill_ms", "decode_ms", "total_ms",
+                  "tokens", "time"):
+            assert k in r, (k, r)
+
+
+def test_disabled_telemetry_fast_paths(paged_dir):
+    """Telemetry off must be FREE: a full engine run with tracing
+    disarmed records zero spans, and a metrics=False server's registry
+    never moves while requests still serve correctly."""
+    rec = recorder()
+    before = rec.spans_recorded
+    assert not rec.enabled
+    with PredictServer(paged_dir, scheduler="on",
+                       metrics=False) as srv:
+        out = _post(srv.port, f"/v1/models/{srv.name}:generate",
+                    {"inputs": {"input_ids": [[1, 2, 3, 4]]}})
+        assert len(out["generations"][0]) == MAX_NEW
+        # timings still measured (host stamps, not registry metrics)
+        assert out["timings"][0]["tokens"] >= 1
+        snap = srv.registry.snapshot()
+        assert all(v["value"] == 0 for v in snap.values()
+                   if v["type"] in ("counter", "gauge")), snap
+        s = json.loads(_get(srv.port, "/stats"))
+        assert s["generate"]["requests_done"] == 0      # inert registry
+    assert rec.spans_recorded == before, (
+        "spans recorded with tracing off")

@@ -212,7 +212,9 @@ def test_queue_full_raises_and_http_429(stepwise_dir):
         def full(*a, **k):
             raise QueueFullError("admission queue full", retry_after=3.0)
 
-        srv.engine.submit_many = full
+        # the HTTP layer submits through submit_many_requests (it needs
+        # the GenRequest objects for request_ids/timings)
+        srv.engine.submit_many_requests = full
         with pytest.raises(urllib.error.HTTPError) as he:
             _post(srv.port, srv.name, "generate",
                   {"inputs": {"input_ids": [p.tolist()]}})
