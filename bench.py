@@ -408,6 +408,11 @@ def _run_decode(*, batch: int, prompt: int, max_new: int, reps: int,
     n_param = sum(int(p.size)
                   for p in jax.tree_util.tree_leaves(params))
     bound_ms = n_param * 2 / 819e9 * 1e3
+    if (gen_kwargs or {}).get("weight_quant") == "int8":
+        # int8 weights halve the per-token read, so the corruption
+        # floor halves with it — a legit int8 reading near ITS bound
+        # must not be flagged suspect against the bf16 one
+        bound_ms /= 2
     on_tpu = jax.devices()[0].platform == "tpu"
 
     def timed_single():
@@ -465,7 +470,14 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
     ``{key}_serving_tps`` / ``{key}_serving_p95_ms`` so the next TPU
     window baselines the serving path, plus the dispatch counters the
     continuous-batching invariant is judged by (decode dispatches ~
-    max per-request length per wave, not the per-request sum)."""
+    max per-request length per wave, not the per-request sum).
+
+    Round 12 adds the fully quantized leg (int8 decode weights + int8
+    paged KV pool): ``serving_int8_tps``, ``serving_int8_drift_rate``
+    (token drift vs the bf16 leg on the SAME seeded matrix — the
+    ROADMAP item-1 quality gate's observable), and per-dtype
+    ``bytes_resident_peak`` so the ~2x-capacity-at-fixed-HBM claim is
+    a baselined column, not folklore."""
     import tempfile
 
     sys.path.insert(0, os.path.join(
@@ -504,11 +516,25 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
         prow = serving_load.run_mode(d, shared, scheduler="on",
                                      prompt_len=prompt_len,
                                      mode_name="paged_shared")
+    # quantized leg (round 12): int8 decode weights + int8 paged KV
+    # pool against the SAME shared matrix — drift is measured against
+    # the bf16 paged leg's token streams (identical seeds), and the
+    # per-dtype residency peaks make the capacity doubling a column
+    with tempfile.TemporaryDirectory() as d:
+        serving_load.build_export(
+            d, prompt_len=prompt_len, max_new=max_new, slots=slots,
+            model_name=model_name, platforms=platforms, paged=True,
+            block_size=block_size, weight_quant="int8",
+            kv_cache_dtype="int8")
+        irow = serving_load.run_mode(d, shared, scheduler="on",
+                                     prompt_len=prompt_len,
+                                     mode_name="int8_shared")
     # counters come from the registry snapshot each run_mode captured
     # (the /metrics exposition = the same atomic snapshot /stats
     # renders) — not re-derived from response bookkeeping, so the
     # bench row can never drift from what the server itself reports
     reg, preg = row["registry"], prow["registry"]
+    ireg = irow["registry"]
     decode_steps = int(reg["serving_decode_steps_total"])
     slot_steps = int(reg["serving_decode_slot_steps_total"])
     admissions = int(preg["serving_admissions_total"])
@@ -526,6 +552,15 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
         "serving_prefill_tokens_saved": int(
             preg["serving_prefill_tokens_saved_total"]),
         "serving_paged_errors": len(prow["errors"]),
+        "serving_int8_tps": irow["tokens_per_s"],
+        "serving_int8_drift_rate": round(
+            1.0 - serving_load.token_agreement(irow["_gens"],
+                                               prow["_gens"]), 4),
+        "serving_int8_errors": len(irow["errors"]),
+        "serving_bytes_resident_peak": int(
+            preg.get("serving_bytes_resident_peak", 0)),
+        "serving_int8_bytes_resident_peak": int(
+            ireg.get("serving_bytes_resident_peak", 0)),
     }
     # per-request latency breakdown (queue vs prefill vs decode) from
     # the request-scoped `timings` field — the p95 gate's diagnosis
@@ -756,6 +791,22 @@ def main() -> None:
                 extra[f"{key}_long_spread"] = round(row["long_spread"], 4)
             if row["suspect"]:
                 extra[f"{key}_suspect"] = True
+            # int8 weight-quant leg (round 12): same program shape with
+            # the decode weights dequantized inside the scan — the
+            # promoted lever-table row, published so the next TPU
+            # window verifies the ~2x tokens/s/chip target (ROADMAP
+            # item 1). No second amortize leg: the int8 row regresses
+            # on token_step_ms until its device-component baseline
+            # exists.
+            irow = _run_decode(**dict(
+                w["decode"], amortize_new=None,
+                gen_kwargs={"weight_quant": "int8"}))
+            extra[f"{key}_int8_token_ms"] = round(
+                irow["token_step_ms"], 3)
+            extra[f"{key}_int8_tokens_s_chip"] = round(
+                irow["tokens_s_chip"])
+            if irow["suspect"]:
+                extra[f"{key}_int8_suspect"] = True
             continue
         eps, ms, mfu, mfu_basis, peak_mib, suspect, anomalies = _run(
             w["model"], batch=w["batch"], steps=w["steps"],

@@ -222,6 +222,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="artifact additionally takes a prompt_mask "
                         "feature (1 = real token) for ragged prompt "
                         "batches")
+    p.add_argument("--gen_weight_quant", default="off",
+                   choices=["off", "int8"],
+                   help="quantize the artifact's decode weights "
+                        "symmetric per-output-channel int8 (scales + "
+                        "quant metadata recorded; dequant inside the "
+                        "stacked scan, so int8 is what crosses HBM per "
+                        "layer step). LOSSY — gated by the documented "
+                        "greedy-drift bound, not byte parity. The "
+                        "paged-pool companion --kv_cache_dtype lives "
+                        "on the serving export surfaces "
+                        "(export_generator / experiments/"
+                        "serving_load.py); it needs paged=True, which "
+                        "this CLI's monolithic export does not build")
     p.add_argument("--warm_start", default=None,
                    help="checkpoint file/dir to initialize params from "
                         "when starting fresh (tf.train.init_from_"
@@ -927,7 +940,8 @@ def _maybe_export(args, cfg, model, state, ctx) -> None:
             temperature=args.gen_temperature,
             top_k=args.gen_top_k, top_p=args.gen_top_p,
             eos_id=args.gen_eos_id, pad_id=args.gen_pad_id,
-            ragged=args.gen_ragged)
+            ragged=args.gen_ragged,
+            weight_quant=args.gen_weight_quant)
         if chief:
             log.info("exported generator: %s", artifact)
 

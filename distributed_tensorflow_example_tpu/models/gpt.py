@@ -47,6 +47,22 @@ from .base import cast_floating, register_model, resolve_dtype
 from .bert import REMAT_POLICIES
 
 
+def quantize_kv_rows(x):
+    """Symmetric per-row int8 quantization of K/V entries: ``x``
+    [..., H, D] -> ``(q int8 [..., H, D], scale f32 [...])`` with
+    ``scale = max|row| / 127`` over each trailing [H, D] plane (eps
+    floor so an all-zero row dequantizes to exact zeros instead of
+    NaN). Deterministic in the row values alone — the property the
+    prefix cache's byte-identity contract rides: the same token prefix
+    always produces the same int8 block bytes, whether written by
+    prefill or by a teacher-forced decode step."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=(-2, -1)),
+                        1e-8) / 127.0
+    q = jnp.round(xf / scale[..., None, None]).astype(jnp.int8)
+    return q, scale
+
+
 @dataclasses.dataclass
 class GPTConfig:
     vocab_size: int = 30522       # framework default vocab (BERT wordpiece)
@@ -687,7 +703,7 @@ class GPT:
     # [L, N, block_size, H, D] physical blocks + per-slot block tables
     # ------------------------------------------------------------------
     def paged_prefill(self, params, input_ids, prompt_mask, k_pool,
-                      v_pool, table_row):
+                      v_pool, table_row, *, k_scale=None, v_scale=None):
         """LEFT-ALIGNED prompt prefill writing WHOLE blocks through a
         block-table row — the paged serving engine's admission program.
 
@@ -708,7 +724,15 @@ class GPT:
         block 0 — whole-block writes land there and are never read).
         Returns ``(logits [1, V] of the last real token, k_pool',
         v_pool')`` with every prompt-capacity block of this row
-        overwritten."""
+        overwritten.
+
+        ``k_scale``/``v_scale`` ([L, N, Bs] f32 parallel pools) switch
+        on QUANTIZE-ON-WRITE for an int8 pool: each token row's [H, D]
+        K/V plane is stored symmetric int8 with its per-row scale
+        (:func:`quantize_kv_rows` — deterministic in the bytes, so
+        prefix-cache sharing mounts byte-identical blocks) and the
+        return grows to ``(logits, k_pool', v_pool', k_scale',
+        v_scale')``."""
         c = self.cfg
         _, s0 = input_ids.shape
         bs = k_pool.shape[2]
@@ -729,8 +753,22 @@ class GPT:
             blocks = stacked[:, 0].reshape(l, nb_p, bs, *stacked.shape[3:])
             return pool.at[:, table_row].set(blocks.astype(pool.dtype))
 
-        return (self.lm_logits(params, last_h[:, None])[:, 0],
-                scatter(k_pool, kv["k"]), scatter(v_pool, kv["v"]))
+        logits = self.lm_logits(params, last_h[:, None])[:, 0]
+        if k_scale is None:
+            return logits, scatter(k_pool, kv["k"]), scatter(v_pool,
+                                                             kv["v"])
+        # int8 pool: quantize each token row before the block scatter;
+        # the scale rows ride a parallel [L, N, Bs] pool through the
+        # same table indices
+        def scatter_q(pool, spool, stacked):
+            q, s = quantize_kv_rows(stacked[:, 0])      # [L,T,H,D]/[L,T]
+            qb = q.reshape(l, nb_p, bs, *q.shape[2:])
+            sb = s.reshape(l, nb_p, bs)
+            return (pool.at[:, table_row].set(qb),
+                    spool.at[:, table_row].set(sb))
+        kq, ks = scatter_q(k_pool, k_scale, kv["k"])
+        vq, vs = scatter_q(v_pool, v_scale, kv["v"])
+        return logits, kq, vq, ks, vs
 
     def decode_step_batched_paged(self, params, stacked, pools,
                                   block_tables, tok, pos, pad,
@@ -745,7 +783,16 @@ class GPT:
         engine guarantees a written block is uniquely owned (copy-on-
         write happens host-side before the step), and a dead row's
         table points at the null block, where its gated write rewrites
-        old bytes."""
+        old bytes.
+
+        int8 KV cache: when ``pools`` additionally carries
+        ``"k_scale"``/``"v_scale"`` ([L, N, Bs] f32), the K/V pools
+        are int8 — the step QUANTIZES its new row on write
+        (:func:`quantize_kv_rows`, same per-row symmetric scheme as
+        :meth:`paged_prefill`, so forced-suffix bytes match what a
+        cold prefill of the same tokens writes up to the drift-gate
+        contract) and both decode-attention impls fuse the dequant
+        into the gather (no dequantized pool tensor ever exists)."""
         from ..ops.pallas.decode_attention import paged_decode_attention
         c = self.cfg
         b = tok.shape[0]
@@ -765,21 +812,42 @@ class GPT:
         rows = jnp.arange(b)
         pbid = bt[rows, pos // bs]                        # [B] physical
         off = pos % bs
+        quant = "k_scale" in pools
 
         def body(h, xs):
-            lp, ck, cv = xs
+            if quant:
+                lp, ck, cv, cks, cvs = xs
+            else:
+                lp, ck, cv = xs
             qkv = nn.dense(self._dequant(lp["qkv"]),
                            nn.layernorm(lp["ln1"], h), dtype=self.dtype)
             q, k, v = [x.reshape(b, c.heads, self.head_dim)
                        for x in jnp.split(qkv, 3, axis=-1)]
-            k_w = jnp.where(alive[:, None, None],
-                            k.astype(ck.dtype), ck[pbid, off])
-            v_w = jnp.where(alive[:, None, None],
-                            v.astype(cv.dtype), cv[pbid, off])
-            ck = ck.at[pbid, off].set(k_w)
-            cv = cv.at[pbid, off].set(v_w)
-            ctx = paged_decode_attention(q, ck, cv, block_tables=bt,
-                                         pos=pos, pad=pad, impl=impl)
+            if quant:
+                # quantize-on-write: the new row's int8 bytes + scale,
+                # gated like the float write (dead rows rewrite old)
+                kq, ksc = quantize_kv_rows(k)
+                vq, vsc = quantize_kv_rows(v)
+                ck = ck.at[pbid, off].set(jnp.where(
+                    alive[:, None, None], kq, ck[pbid, off]))
+                cv = cv.at[pbid, off].set(jnp.where(
+                    alive[:, None, None], vq, cv[pbid, off]))
+                cks = cks.at[pbid, off].set(jnp.where(
+                    alive, ksc, cks[pbid, off]))
+                cvs = cvs.at[pbid, off].set(jnp.where(
+                    alive, vsc, cvs[pbid, off]))
+                ctx = paged_decode_attention(
+                    q, ck, cv, block_tables=bt, pos=pos, pad=pad,
+                    k_scale=cks, v_scale=cvs, impl=impl)
+            else:
+                k_w = jnp.where(alive[:, None, None],
+                                k.astype(ck.dtype), ck[pbid, off])
+                v_w = jnp.where(alive[:, None, None],
+                                v.astype(cv.dtype), cv[pbid, off])
+                ck = ck.at[pbid, off].set(k_w)
+                cv = cv.at[pbid, off].set(v_w)
+                ctx = paged_decode_attention(q, ck, cv, block_tables=bt,
+                                             pos=pos, pad=pad, impl=impl)
             a = nn.dense(self._dequant(lp["o"]), ctx.reshape(b, c.hidden),
                          dtype=self.dtype)
             h = h + a.astype(h.dtype)
@@ -788,13 +856,20 @@ class GPT:
             f = jax.nn.gelu(f.astype(jnp.float32)).astype(self.dtype)
             f = nn.dense(self._dequant(lp["ffn_out"]), f, dtype=self.dtype)
             h = h + f.astype(h.dtype)
-            return h, (ck, cv)
+            return h, (ck, cv, cks, cvs) if quant else (ck, cv)
 
-        h, (ks, vs) = lax.scan(body, h,
-                               (stacked, pools["k"], pools["v"]))
+        if quant:
+            h, (ks, vs, kss, vss) = lax.scan(
+                body, h, (stacked, pools["k"], pools["v"],
+                          pools["k_scale"], pools["v_scale"]))
+            out_pools = {"k": ks, "v": vs, "k_scale": kss,
+                         "v_scale": vss}
+        else:
+            h, (ks, vs) = lax.scan(body, h,
+                                   (stacked, pools["k"], pools["v"]))
+            out_pools = {"k": ks, "v": vs}
         h = nn.layernorm(params["ln_f"], h)
-        return (self.lm_logits(params, h[:, None])[:, 0],
-                {"k": ks, "v": vs})
+        return self.lm_logits(params, h[:, None])[:, 0], out_pools
 
     def _stack_caches(self, caches):
         """Per-layer {layer_i: {k, v}} prefill caches -> the stacked

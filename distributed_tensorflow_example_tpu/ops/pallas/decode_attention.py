@@ -148,29 +148,54 @@ def paged_tile_friendly(block_size: int, head_dim: int) -> bool:
 
 def xla_paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                                v_pool: jax.Array, *, block_tables,
-                               pos, pad) -> jax.Array:
+                               pos, pad, k_scale=None,
+                               v_scale=None) -> jax.Array:
     """Reference path: gather each row's block run out of the pool (one
     advanced-indexing gather -> the row's [T, H, D] logical cache, with
     T = blocks_per_row * block_size) and run the exact slab reference.
     Bitwise equal to the slab path on equal logical contents — the
-    paged byte-parity oracle."""
+    paged byte-parity oracle.
+
+    int8 pools (``k_scale``/``v_scale`` [N, Bs] f32 per-row scales):
+    the gather additionally dequantizes each row — f32 multiply, cast
+    to the query dtype — before the slab reference math (the kernel
+    path's parity oracle for the quantized cache)."""
     n, bs, h, d = k_pool.shape
     bt = jnp.asarray(block_tables, jnp.int32)
     b, nb = bt.shape
-    kg = k_pool[bt].reshape(b, nb * bs, h, d)
-    vg = v_pool[bt].reshape(b, nb * bs, h, d)
-    return xla_decode_attention(q, kg, vg, pos=pos, pad=pad)
+
+    def gather(pool, scale):
+        g = pool[bt]                                # [B, NB, Bs, H, D]
+        if scale is not None:
+            g = (g.astype(jnp.float32)
+                 * scale[bt][..., None, None]).astype(q.dtype)
+        return g.reshape(b, nb * bs, h, d)
+
+    return xla_decode_attention(q, gather(k_pool, k_scale),
+                                gather(v_pool, v_scale), pos=pos,
+                                pad=pad)
 
 
-def _paged_kernel(bt_ref, pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, block_size: int,
-                  sm_scale: float):
+def _paged_kernel(bt_ref, pos_ref, pad_ref, q_ref, k_ref, v_ref, *rest,
+                  block_size: int, sm_scale: float, quant: bool):
     """Grid (B, H, NB): one [block_size, D] K/V block per step, gathered
     through the block table by the index maps (scalar prefetch). The
     softmax runs online over the NB dimension (m/l/acc scratch persists
     across the revisited output block); masked slots are zeroed
     explicitly so never-written pool blocks (incl. the engine's null
-    block) contribute exact 0 regardless of their bytes."""
+    block) contribute exact 0 regardless of their bytes.
+
+    ``quant=True`` (int8 pools): two extra [1, 1, Bs] scale-row inputs
+    follow v. The dequant is fused ALGEBRAICALLY — K's per-row scale
+    multiplies the score COLUMNS (q·(k·s)ᵀ = (q·kᵀ)·s, broadcast along
+    the [1, Bs] score row) and V's scale folds into the probabilities
+    before the context matmul (p·(v·s) = (p·s)·v) — so no dequantized
+    [Bs, D] tile is ever materialized and no transpose of the scale
+    row is needed."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -184,6 +209,8 @@ def _paged_kernel(bt_ref, pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
     k = k_ref[0].astype(jnp.float32)                    # [Bs, D]
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * sm_scale
+    if quant:
+        s = s * ks_ref[0]                               # [1, Bs] scales
     kpos = j * block_size + lax.broadcasted_iota(
         jnp.int32, (1, block_size), 1)
     live = (kpos <= pos_ref[b]) & (kpos >= pad_ref[b])
@@ -195,9 +222,15 @@ def _paged_kernel(bt_ref, pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
     p = jnp.where(live, jnp.exp(s - m_new), 0.0)        # [1, Bs]
     alpha = jnp.exp(m_prev - m_new)
     l_new = l_ref[0, 0] * alpha + jnp.sum(p)
+    if quant:
+        pv = p * vs_ref[0]                              # fold V scales
+        vblk = v_ref[0].astype(jnp.float32)
+    else:
+        pv = p.astype(v_ref.dtype)
+        vblk = v_ref[0]
     acc_ref[...] = (acc_ref[...] * alpha
                     + lax.dot_general(
-                        p.astype(v_ref.dtype), v_ref[0],
+                        pv, vblk,
                         (((1,), (0,)), ((), ())),
                         preferred_element_type=jnp.float32))
     m_ref[0, 0] = m_new
@@ -209,13 +242,18 @@ def _paged_kernel(bt_ref, pos_ref, pad_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_ref[...] / l_ref[0, 0]).astype(o_ref.dtype)
 
 
-def _paged_dispatch(q, k_pool, v_pool, block_tables, pos, pad):
+def _paged_dispatch(q, k_pool, v_pool, block_tables, pos, pad,
+                    k_scale=None, v_scale=None):
     """Grid (B, H, NB); per program ONE [Bs, D] K/V plane of the pool,
     selected by the block table via scalar-prefetch index maps. Same
     [N, Bs, H·D]-view trick as the slab kernel so every tile is
-    Mosaic-friendly."""
+    Mosaic-friendly. int8 pools additionally stream the matching
+    [1, Bs] scale row per block ([N, 1, Bs] view so the singleton tile
+    dim matches its array dim — the Mosaic tiling rule the slab
+    kernel's docstring records)."""
     n, bs, h, d = k_pool.shape
     b, nb = block_tables.shape
+    quant = k_scale is not None
     q3 = q.reshape(b * h, 1, d)
     k3 = k_pool.reshape(n, bs, h * d)
     v3 = v_pool.reshape(n, bs, h * d)
@@ -223,17 +261,26 @@ def _paged_dispatch(q, k_pool, v_pool, block_tables, pos, pad):
     def kv_map(bb, hh, jj, bt, pos_s, pad_s):
         return (bt[bb, jj], 0, hh)
 
+    def scale_map(bb, hh, jj, bt, pos_s, pad_s):
+        return (bt[bb, jj], 0, 0)
+
     def q_map(bb, hh, jj, bt, pos_s, pad_s):
         return (bb * h + hh, 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, d), q_map),
+        pl.BlockSpec((1, bs, d), kv_map),
+        pl.BlockSpec((1, bs, d), kv_map),
+    ]
+    operands = [q3, k3, v3]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, bs), scale_map)] * 2
+        operands += [k_scale.reshape(n, 1, bs).astype(jnp.float32),
+                     v_scale.reshape(n, 1, bs).astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,          # block_tables, pos, pad
         grid=(b, h, nb),
-        in_specs=[
-            pl.BlockSpec((1, 1, d), q_map),
-            pl.BlockSpec((1, bs, d), kv_map),
-            pl.BlockSpec((1, bs, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, d), q_map),
         scratch_shapes=[
             pltpu.SMEM((1, 1), jnp.float32),            # running max
@@ -243,18 +290,20 @@ def _paged_dispatch(q, k_pool, v_pool, block_tables, pos, pad):
     )
     out = pl.pallas_call(
         functools.partial(_paged_kernel, block_size=bs,
-                          sm_scale=1.0 / math.sqrt(d)),
+                          sm_scale=1.0 / math.sqrt(d), quant=quant),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), v_pool.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (b * h, 1, d), q.dtype if quant else v_pool.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(block_tables, pos, pad, q3, k3, v3)
+    )(block_tables, pos, pad, *operands)
     return out.reshape(b, h, d)
 
 
 def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, *, block_tables, pos, pad,
+                           k_scale=None, v_scale=None,
                            impl: str = "auto") -> jax.Array:
     """One-query attention against the block-paged cache pool.
 
@@ -264,6 +313,13 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     ``pos``/``pad``: [B] int32, the same live-window semantics as the
     slab path (row b attends to logical slots ``pad_b <= j <= pos_b``).
     Returns [B, H, D] context.
+
+    int8 KV cache: pass the pools as int8 plus ``k_scale``/``v_scale``
+    ([N, Bs] f32 per-token-row scales) — BOTH impls fuse the dequant
+    into the gather (the kernel algebraically, the XLA path on the
+    gathered rows); the context dtype is then the QUERY's dtype. The
+    scales and the int8 pools travel together: one without the other
+    is a loud error, never a silent garbage read.
 
     ``impl`` as in :func:`decode_attention`; the kernel path needs
     :func:`paged_tile_friendly` shapes, anything else falls back to the
@@ -276,6 +332,25 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                          f"{k_pool.shape}")
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown decode attention impl {impl!r}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together "
+                         "(int8 pools carry one scale row per cached "
+                         "token for BOTH k and v)")
+    if k_scale is not None:
+        if k_pool.dtype != jnp.int8 or v_pool.dtype != jnp.int8:
+            raise ValueError(
+                f"k_scale/v_scale describe int8 pools, got pool dtype "
+                f"{k_pool.dtype}/{v_pool.dtype}")
+        if tuple(k_scale.shape) != (n, bs) \
+                or tuple(v_scale.shape) != (n, bs):
+            raise ValueError(
+                f"scale shape {tuple(k_scale.shape)}/"
+                f"{tuple(v_scale.shape)} != per-row ({n}, {bs}) from "
+                f"pool {k_pool.shape}")
+    elif k_pool.dtype == jnp.int8:
+        raise ValueError("int8 pools need k_scale/v_scale — attending "
+                         "over raw int8 bytes would silently produce "
+                         "garbage context")
     bt = jnp.asarray(block_tables, jnp.int32)
     if bt.ndim != 2 or bt.shape[0] != b:
         raise ValueError(f"block_tables shape {bt.shape} != ({b}, NB)")
@@ -292,8 +367,10 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     if not use_kernel:
         return xla_paged_decode_attention(q, k_pool, v_pool,
                                           block_tables=bt, pos=posb,
-                                          pad=padb)
-    return _paged_dispatch(q, k_pool, v_pool, bt, posb, padb)
+                                          pad=padb, k_scale=k_scale,
+                                          v_scale=v_scale)
+    return _paged_dispatch(q, k_pool, v_pool, bt, posb, padb,
+                           k_scale=k_scale, v_scale=v_scale)
 
 
 def decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

@@ -150,6 +150,39 @@ def export_model(model, params, extras, out_dir: str, *,
                            batch_polymorphic=batch_polymorphic)
 
 
+#: quant metadata schema version recorded in every generator export —
+#: the loader refuses artifacts claiming a NEWER schema (fields it
+#: cannot validate) instead of shape-erroring deep in the scan
+QUANT_SCHEMA = 1
+
+
+def _normalize_weight_quant(weight_quant) -> str | None:
+    """Loud CLI/export validation of the weight-quant knob: ``None`` /
+    ``"off"`` -> None, ``"int8"`` -> "int8", anything else raises."""
+    if weight_quant in (None, "off"):
+        return None
+    if weight_quant == "int8":
+        return "int8"
+    raise ValueError(f"weight_quant must be 'off' or 'int8', got "
+                     f"{weight_quant!r}")
+
+
+def _normalize_kv_cache_dtype(kv_cache_dtype, model_dtype):
+    """The KV-cache storage knob: ``None``/``"auto"`` keeps the model
+    compute dtype (today's behavior — the quant-off bitwise no-op),
+    ``"bf16"`` stores bfloat16 explicitly, ``"int8"`` selects the
+    quantized pool (paged artifacts only — the caller enforces that).
+    Returns ``(np.dtype for the pool, "int8" | None)``."""
+    if kv_cache_dtype in (None, "auto"):
+        return np.dtype(jnp.dtype(model_dtype)), None
+    if kv_cache_dtype in ("bf16", "bfloat16"):
+        return np.dtype(jnp.dtype(jnp.bfloat16)), None
+    if kv_cache_dtype == "int8":
+        return np.dtype(np.int8), "int8"
+    raise ValueError(f"kv_cache_dtype must be 'auto', 'bf16' or "
+                     f"'int8', got {kv_cache_dtype!r}")
+
+
 def export_generator(model, params, out_dir: str, *,
                      prompt_len: int, max_new_tokens: int,
                      batch_size: int = 1, temperature: float = 0.0,
@@ -161,6 +194,9 @@ def export_generator(model, params, out_dir: str, *,
                      stepwise: bool = False, slots: int = 8,
                      paged: bool = False, block_size: int = 16,
                      num_blocks: int | None = None,
+                     weight_quant: str | None = None,
+                     kv_cache_dtype: str | None = None,
+                     pool_bytes: int | None = None,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Serialize ``model.generate`` (params baked; greedy or
     temperature/top-k/top-p sampling, optional EOS early-stop) as a
@@ -219,9 +255,60 @@ def export_generator(model, params, out_dir: str, *,
     entries point at it). Slab artifacts remain exportable (the
     default) as the paged path's parity oracle; ``block_size`` /
     ``num_blocks`` land in the ``stepwise`` metadata so the engine and
-    bench rows can report block-level residency."""
+    bench rows can report block-level residency.
+
+    Quantized decode (round 12):
+
+    - ``weight_quant="int8"`` bakes the decode-path layer weights as
+      symmetric per-output-channel int8 + f32 scales
+      (``GPT.stack_decode_params``) into EVERY decode program of this
+      export — the monolithic generation, and the stepwise/paged
+      decode step — with the dequant inside the scan body, so int8 is
+      what crosses HBM per layer step. Prefill stays full precision
+      (it is compute-bound, and the monolithic path's prefill already
+      is). LOSSY by contract: gated by greedy-drift bounds, not byte
+      parity.
+    - ``kv_cache_dtype="int8"`` (requires ``paged=True``) stores the
+      cache pool int8 with per-token-row f32 scales in parallel
+      ``cache_k_scale``/``cache_v_scale`` [L, N, Bs] pools —
+      quantize-on-write in prefill and the decode step, dequant fused
+      into both decode-attention impls. ``"bf16"`` stores bfloat16
+      explicitly; ``"auto"`` (default) keeps the model dtype — the
+      bitwise no-op.
+    - ``pool_bytes`` sizes the paged pool IN BYTES: ``num_blocks`` =
+      the block count whose K/V bytes fit the budget (+ the null
+      block), so an int8 pool genuinely holds >= 2x the bf16 block
+      count at equal bytes (the scale pools are accounted separately
+      in the recorded ``block_bytes`` — ~``8/(H*D)`` relative
+      overhead — and in the engine's ``bytes_resident``). Mutually
+      exclusive with ``num_blocks``.
+
+    Every generator export records ``quant_schema`` + ``weight_quant``
+    (and, stepwise, ``kv_cache_dtype`` / ``kv_scale_shape``) so
+    loaders can validate quant expectations loudly instead of
+    shape-erroring deep in the scan."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
+
+    weight_quant = _normalize_weight_quant(weight_quant)
+    cache_dtype, kv_quant = _normalize_kv_cache_dtype(
+        kv_cache_dtype, model.dtype)
+    if kv_quant and not paged:
+        raise ValueError(
+            "kv_cache_dtype='int8' quantizes the BLOCK-PAGED pool "
+            "(per-block-row scales need the paged layout) — export "
+            "with paged=True, or drop the knob")
+    if pool_bytes is not None:
+        if not paged:
+            raise ValueError("pool_bytes sizes the paged block pool "
+                             "and requires paged=True")
+        if num_blocks is not None:
+            raise ValueError("pass pool_bytes OR num_blocks, not both "
+                             "(pool_bytes derives num_blocks from the "
+                             "byte budget)")
+        if pool_bytes < 1:
+            raise ValueError(f"pool_bytes must be >= 1, got "
+                             f"{pool_bytes}")
 
     sampled = temperature > 0.0
     tpu_only_on_tpu = (tuple(platforms) == ("tpu",)
@@ -238,6 +325,7 @@ def export_generator(model, params, out_dir: str, *,
             decode_impl=decode_impl,
             decode_attention=decode_attention,
             tokens_per_dispatch=tokens_per_dispatch,
+            weight_quant=weight_quant,
             rng=(jax.random.wrap_key_data(feats["rng"])
                  if sampled else None))
 
@@ -272,7 +360,9 @@ def export_generator(model, params, out_dir: str, *,
             model, params, out_dir, prompt_len=prompt_len,
             max_new_tokens=max_new_tokens, slots=slots,
             decode_attention=decode_attention, platforms=platforms,
-            paged=paged, block_size=block_size, num_blocks=num_blocks)
+            paged=paged, block_size=block_size, num_blocks=num_blocks,
+            weight_quant=weight_quant, cache_dtype=cache_dtype,
+            kv_quant=kv_quant, pool_bytes=pool_bytes)
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
                            prompt_len=prompt_len,
@@ -281,6 +371,8 @@ def export_generator(model, params, out_dir: str, *,
                            top_p=top_p, eos_id=eos_id, pad_id=pad_id,
                            ragged=ragged, decode_impl=decode_impl,
                            tokens_per_dispatch=tokens_per_dispatch,
+                           quant_schema=QUANT_SCHEMA,
+                           weight_quant=weight_quant,
                            **extra_meta)
 
 
@@ -311,7 +403,10 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
                      decode_attention: str | None,
                      platforms: Sequence[str], paged: bool = False,
                      block_size: int = 16,
-                     num_blocks: int | None = None) -> dict:
+                     num_blocks: int | None = None,
+                     weight_quant: str | None = None,
+                     cache_dtype=None, kv_quant: str | None = None,
+                     pool_bytes: int | None = None) -> dict:
     """Trace + serialize the prefill and shared-decode-step programs
     (see :func:`export_generator` ``stepwise=True``); returns the
     ``stepwise`` metadata block. Params are already host-gathered."""
@@ -323,7 +418,8 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
         raise ValueError(
             f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
             f"exceeds max_len {c.max_len}")
-    cache_dtype = np.dtype(jnp.dtype(model.dtype))
+    if cache_dtype is None:
+        cache_dtype = np.dtype(jnp.dtype(model.dtype))
 
     def base_meta(pool_shape) -> dict:
         return {
@@ -333,6 +429,7 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
             "max_context": total,
             "pool_shape": list(pool_shape),
             "cache_dtype": str(cache_dtype),
+            "kv_cache_dtype": ("int8" if kv_quant else str(cache_dtype)),
             "vocab_size": c.vocab_size,
         }
 
@@ -342,7 +439,9 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
             max_new_tokens=max_new_tokens, slots=slots,
             decode_attention=decode_attention, platforms=platforms,
             block_size=block_size, num_blocks=num_blocks,
-            cache_dtype=cache_dtype, base_meta=base_meta)
+            cache_dtype=cache_dtype, base_meta=base_meta,
+            weight_quant=weight_quant, kv_quant=kv_quant,
+            pool_bytes=pool_bytes)
     head_dim = c.hidden // c.heads
     pool_shape = (c.layers, slots, total, c.heads, head_dim)
 
@@ -360,7 +459,7 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
         return {"logits": model.lm_logits(params, last_h[:, None])[:, 0],
                 "pad": pad, "cache_k": ck, "cache_v": cv}
 
-    stacked = model.stack_decode_params(params)
+    stacked = model.stack_decode_params(params, weight_quant=weight_quant)
 
     def decode_fn(feats):
         logits, new = model.decode_step_batched(
@@ -393,18 +492,38 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
                            slots: int, decode_attention: str | None,
                            platforms: Sequence[str], block_size: int,
                            num_blocks: int | None, cache_dtype,
-                           base_meta) -> dict:
+                           base_meta, weight_quant: str | None = None,
+                           kv_quant: str | None = None,
+                           pool_bytes: int | None = None) -> dict:
     """The block-paged stepwise pair (``export_generator``
     ``paged=True``): prefill writes a prompt's whole blocks through a
     table row, the shared decode step reads/writes through per-slot
     tables. Same artifact filenames as the slab pair — the ``paged``
-    metadata key is the dispatch contract."""
+    metadata key is the dispatch contract.
+
+    ``kv_quant="int8"``: the pools are int8 with per-token-row f32
+    scales in parallel ``cache_k_scale``/``cache_v_scale`` [L, N, Bs]
+    pools threaded through both programs. ``pool_bytes`` derives
+    ``num_blocks`` from the K/V byte budget — the lever that makes
+    int8 hold 2x the bf16 block count at fixed HBM (the small scale
+    pools are accounted in the recorded ``block_bytes``, not the block
+    budget — ~8/(H·D) relative overhead)."""
     c = model.cfg
     total = prompt_len + max_new_tokens
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
     blocks_per_slot = -(-total // block_size)
     prompt_blocks = -(-prompt_len // block_size)
+    head_dim = c.hidden // c.heads
+    # bytes of one block's K+V payload at the storage dtype (int8
+    # itemsize 1 — exactly half of bf16, the capacity doubling)
+    kv_block_bytes = 2 * c.layers * block_size * c.heads * head_dim \
+        * int(np.dtype(cache_dtype).itemsize)
+    # total per-block residency incl. the int8 scale rows (k+v, f32)
+    block_bytes = kv_block_bytes + (
+        2 * c.layers * block_size * 4 if kv_quant else 0)
+    if pool_bytes is not None:
+        num_blocks = 1 + pool_bytes // kv_block_bytes
     if num_blocks is None:
         # default: the slab pool's token capacity, block-granular,
         # plus the reserved null block — equal bytes, equal worst case
@@ -415,31 +534,55 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
             f"num_blocks {num_blocks} leaves {usable} usable blocks "
             f"(block 0 is the reserved null block) but one full-depth "
             f"request needs {blocks_per_slot} blocks of {block_size} "
-            "tokens — raise num_blocks or block_size")
-    head_dim = c.hidden // c.heads
+            "tokens — raise num_blocks or block_size"
+            + (f" (pool_bytes {pool_bytes} at {kv_block_bytes} K/V "
+               "bytes per block)" if pool_bytes is not None else ""))
     pool_shape = (c.layers, num_blocks, block_size, c.heads, head_dim)
+    scale_shape = (c.layers, num_blocks, block_size)
+
+    pool_specs = {
+        "cache_k": jax.ShapeDtypeStruct(pool_shape, cache_dtype),
+        "cache_v": jax.ShapeDtypeStruct(pool_shape, cache_dtype)}
+    if kv_quant:
+        pool_specs.update({
+            "cache_k_scale": jax.ShapeDtypeStruct(scale_shape,
+                                                  np.float32),
+            "cache_v_scale": jax.ShapeDtypeStruct(scale_shape,
+                                                  np.float32)})
 
     def prefill_fn(feats):
+        if kv_quant:
+            logits, ck, cv, cks, cvs = model.paged_prefill(
+                params, feats["input_ids"], feats["prompt_mask"],
+                feats["cache_k"], feats["cache_v"], feats["table_row"],
+                k_scale=feats["cache_k_scale"],
+                v_scale=feats["cache_v_scale"])
+            return {"logits": logits, "cache_k": ck, "cache_v": cv,
+                    "cache_k_scale": cks, "cache_v_scale": cvs}
         logits, ck, cv = model.paged_prefill(
             params, feats["input_ids"], feats["prompt_mask"],
             feats["cache_k"], feats["cache_v"], feats["table_row"])
         return {"logits": logits, "cache_k": ck, "cache_v": cv}
 
-    stacked = model.stack_decode_params(params)
+    stacked = model.stack_decode_params(params, weight_quant=weight_quant)
 
     def decode_fn(feats):
+        pools = {"k": feats["cache_k"], "v": feats["cache_v"]}
+        if kv_quant:
+            pools.update({"k_scale": feats["cache_k_scale"],
+                          "v_scale": feats["cache_v_scale"]})
         logits, new = model.decode_step_batched_paged(
-            params, stacked,
-            {"k": feats["cache_k"], "v": feats["cache_v"]},
+            params, stacked, pools,
             feats["block_tables"], feats["tok"], feats["pos"],
             feats["pad"], feats["alive"],
             decode_attention=decode_attention)
-        return {"logits": logits, "cache_k": new["k"],
-                "cache_v": new["v"]}
+        out = {"logits": logits, "cache_k": new["k"],
+               "cache_v": new["v"]}
+        if kv_quant:
+            out.update({"cache_k_scale": new["k_scale"],
+                        "cache_v_scale": new["v_scale"]})
+        return out
 
-    pool_specs = {
-        "cache_k": jax.ShapeDtypeStruct(pool_shape, cache_dtype),
-        "cache_v": jax.ShapeDtypeStruct(pool_shape, cache_dtype)}
     prefill_specs = {
         "input_ids": jax.ShapeDtypeStruct((1, prompt_len), np.int32),
         "prompt_mask": jax.ShapeDtypeStruct((1, prompt_len), np.int32),
@@ -453,12 +596,71 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
         "block_tables": jax.ShapeDtypeStruct((slots, blocks_per_slot),
                                              np.int32),
         **pool_specs}
+    quant_meta = {}
+    if kv_quant:
+        quant_meta = {"kv_scale_shape": list(scale_shape),
+                      "kv_scale_dtype": "float32"}
     return _trace_and_write_stepwise(
         out_dir, prefill_fn, decode_fn, prefill_specs, decode_specs,
         platforms, base_meta(pool_shape),
         paged=True, block_size=block_size, num_blocks=num_blocks,
         blocks_per_slot=blocks_per_slot, prompt_blocks=prompt_blocks,
-        layout="left_aligned")
+        layout="left_aligned", block_bytes=block_bytes, **quant_meta)
+
+
+def validate_quant_meta(meta: dict, *, where: str = "artifact") -> None:
+    """Loud load-time validation of an artifact's quantization
+    metadata — every mismatch names the ``export.json`` field instead
+    of shape-erroring deep inside the scan. Artifacts predating the
+    quant schema (no ``quant_schema`` key) pass untouched: they carry
+    no quant features (callers may count them via
+    ``serving_quant_fallback_total``)."""
+    schema = meta.get("quant_schema")
+    if schema is None:
+        return
+    if not isinstance(schema, int) or schema < 1 or schema > QUANT_SCHEMA:
+        raise ValueError(
+            f"{where}: metadata field 'quant_schema'={schema!r} is not "
+            f"supported by this loader (understands 1..{QUANT_SCHEMA}) "
+            "— re-export the artifact or upgrade the server")
+    wq = meta.get("weight_quant")
+    if wq not in (None, "int8"):
+        raise ValueError(
+            f"{where}: metadata field 'weight_quant'={wq!r} names an "
+            "unknown weight quantization (known: null, 'int8')")
+    sm = meta.get("stepwise")
+    if not sm:
+        return
+    kd = sm.get("kv_cache_dtype", sm.get("cache_dtype"))
+    if kd == "int8":
+        if not sm.get("paged"):
+            raise ValueError(
+                f"{where}: metadata field 'stepwise.kv_cache_dtype'="
+                "'int8' requires a paged artifact ('stepwise.paged' is "
+                "false) — the int8 pool's scale rows ride the block "
+                "layout")
+        want = [sm["pool_shape"][i] for i in (0, 1, 2)]   # [L, N, Bs]
+        got = sm.get("kv_scale_shape")
+        if got != want:
+            raise ValueError(
+                f"{where}: metadata field 'stepwise.kv_scale_shape'="
+                f"{got!r} does not match the per-token-row layout "
+                f"{want} implied by 'stepwise.pool_shape'="
+                f"{sm['pool_shape']}")
+        sd = sm.get("kv_scale_dtype", "float32")
+        try:
+            np.dtype(sd)
+        except TypeError as e:
+            raise ValueError(
+                f"{where}: metadata field 'stepwise.kv_scale_dtype'="
+                f"{sd!r} is not a dtype: {e}") from e
+    elif kd is not None:
+        try:
+            np.dtype(kd)
+        except TypeError as e:
+            raise ValueError(
+                f"{where}: metadata field 'stepwise.kv_cache_dtype'="
+                f"{kd!r} is not a dtype (or 'int8'): {e}") from e
 
 
 class ServableModel:
@@ -470,6 +672,7 @@ class ServableModel:
     def __init__(self, directory: str):
         with open(os.path.join(directory, _META)) as f:
             self.meta = json.load(f)
+        validate_quant_meta(self.meta, where=directory)
         with open(os.path.join(directory, _ARTIFACT), "rb") as f:
             self._exported = jax_export.deserialize(f.read())
         self._call = jax.jit(self._exported.call)
@@ -514,11 +717,16 @@ class StepwiseGenerator:
                 f"{directory!r} holds no stepwise generator artifacts — "
                 "re-export with export_generator(..., stepwise=True) "
                 "(or serve it with the scheduler off)")
+        validate_quant_meta(self.meta, where=directory)
         self.step_meta = step_meta
         #: block-paged artifacts ([L, N, Bs, H, D] pool + block tables)
         #: vs the slab pair ([L, slots, T, H, D]) — the engine branches
         #: its allocator/prefix-cache machinery on this
         self.paged: bool = bool(step_meta.get("paged", False))
+        #: "int8" for the quantized pool (parallel scale pools ride
+        #: along in make_pool/_split), else the storage float dtype
+        self.kv_cache_dtype: str = str(
+            step_meta.get("kv_cache_dtype", step_meta["cache_dtype"]))
         with open(os.path.join(directory, _PREFILL), "rb") as f:
             self._prefill_exp = jax_export.deserialize(f.read())
         with open(os.path.join(directory, _DECODE), "rb") as f:
@@ -538,18 +746,28 @@ class StepwiseGenerator:
 
     def make_pool(self) -> dict:
         """A zeroed cache pool of the exported shape (the engine's
-        one-time allocation)."""
+        one-time allocation) — int8 artifacts include the parallel
+        per-token-row scale pools."""
         m = self.step_meta
         shape = tuple(m["pool_shape"])
         dtype = np.dtype(m["cache_dtype"])
-        return {"cache_k": jnp.zeros(shape, dtype),
+        pool = {"cache_k": jnp.zeros(shape, dtype),
                 "cache_v": jnp.zeros(shape, dtype)}
+        if self.kv_cache_dtype == "int8":
+            sshape = tuple(m["kv_scale_shape"])
+            sdtype = np.dtype(m.get("kv_scale_dtype", "float32"))
+            pool.update({"cache_k_scale": jnp.zeros(sshape, sdtype),
+                         "cache_v_scale": jnp.zeros(sshape, sdtype)})
+        return pool
 
     @staticmethod
     def _split(feats: dict) -> tuple[dict, dict]:
-        pool = {k: feats[k] for k in ("cache_k", "cache_v")}
+        # every cache_* operand (K/V pools + int8 scale pools) is part
+        # of the donated pool group; the small int arrays are not
+        pool = {k: v for k, v in feats.items()
+                if k.startswith("cache_")}
         rest = {k: v for k, v in feats.items()
-                if k not in ("cache_k", "cache_v")}
+                if not k.startswith("cache_")}
         return pool, rest
 
     def prefill(self, feats: dict) -> dict:

@@ -135,6 +135,22 @@ class BlockPool:
         # — physical contiguity is irrelevant, the table indirection IS
         # the defragmenter
         self._free = list(range(num_blocks - 1, 0, -1))
+        #: high-water mark of blocks in use — the bytes_resident_peak
+        #: observable (per-dtype residency for the bench rows)
+        self.peak_in_use = 0
+
+    @classmethod
+    def from_bytes(cls, pool_bytes: int, block_bytes: int) -> "BlockPool":
+        """Size the pool IN BYTES: as many usable blocks as
+        ``block_bytes``-sized K/V payloads fit the budget, plus the
+        reserved null block — the sizing rule under which an int8
+        cache (half the payload bytes) genuinely doubles the block
+        count at fixed HBM. Mirrors ``export_generator``'s
+        ``pool_bytes`` math."""
+        if block_bytes < 1:
+            raise ValueError(f"block_bytes must be >= 1, got "
+                             f"{block_bytes}")
+        return cls(1 + pool_bytes // block_bytes)
 
     @property
     def usable(self) -> int:
@@ -143,6 +159,10 @@ class BlockPool:
     @property
     def free_count(self) -> int:
         return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.usable - len(self._free)
 
     def alloc(self, n: int) -> list[int]:
         """``n`` fresh blocks, refcount 1 each — all-or-nothing (a
@@ -154,6 +174,7 @@ class BlockPool:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._ref[b] = 1
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
         return out
 
     def retain(self, blocks) -> None:
@@ -505,6 +526,11 @@ class GenerationEngine:
             "serving_cow_copies_total",
             "copy-on-write block copies (divergence from a shared "
             "block)")
+        # the cache pool's storage dtype ("int8" for the quantized
+        # pool) — /stats and the bench rows report residency per dtype
+        self.kv_cache_dtype: str = str(
+            getattr(stepwise, "kv_cache_dtype",
+                    m.get("kv_cache_dtype", m["cache_dtype"])))
         if self.paged:
             self.block_size = int(m["block_size"])
             self.num_blocks = int(m["num_blocks"])
@@ -516,6 +542,10 @@ class GenerationEngine:
             self._g_bytes_resident = reg.gauge(
                 "serving_bytes_resident",
                 "bytes of K/V actually resident in allocated blocks")
+            self._g_bytes_resident_peak = reg.gauge(
+                "serving_bytes_resident_peak",
+                "high-water mark of resident K/V bytes (incl. int8 "
+                "scale rows) over the engine's lifetime")
             self._g_prefix_entries = reg.gauge(
                 "serving_prefix_cache_entries",
                 "live prefix-cache entries")
@@ -528,12 +558,29 @@ class GenerationEngine:
             self._tables = np.zeros((self.slots, self.blocks_per_slot),
                                     np.int32)
             shape = m["pool_shape"]                # [L, N, Bs, H, D]
-            self._block_bytes = 2 * int(np.prod(
-                [shape[0], shape[2], shape[3], shape[4]])) * np.dtype(
-                    m["cache_dtype"]).itemsize
+            # per-block residency incl. int8 scale rows: recorded at
+            # export since round 12; the fallback recomputes the K/V
+            # payload for pre-quant artifacts
+            self._block_bytes = int(m.get("block_bytes") or (
+                2 * int(np.prod([shape[0], shape[2], shape[3],
+                                 shape[4]])) * np.dtype(
+                    m["cache_dtype"]).itemsize))
             self._copy_block = self._make_block_copy()
         else:
             self.prefix_cache = None
+        # bytes one cached token costs at this artifact's kv dtype
+        # (K+V payload + scale rows) — the /metrics-visible dtype
+        # signal next to the string in /stats
+        shape = m["pool_shape"]
+        tok_bytes = 2 * int(np.prod([shape[0], shape[3], shape[4]])) \
+            * np.dtype(m["cache_dtype"]).itemsize
+        if self.kv_cache_dtype == "int8":
+            tok_bytes += 2 * int(shape[0]) * 4       # f32 scale rows
+        self._g_kv_bytes_per_token = reg.gauge(
+            "serving_kv_cache_bytes_per_token",
+            "bytes one cached token occupies at the artifact's "
+            "kv_cache_dtype (K+V payload plus int8 scale rows)")
+        self._g_kv_bytes_per_token.set(tok_bytes)
 
     @staticmethod
     def _make_block_copy():
@@ -830,8 +877,8 @@ class GenerationEngine:
             out = self.sw.prefill({
                 "input_ids": ids, "prompt_mask": mask,
                 "slot": np.int32(index), **self._pool})
-            self._pool = {"cache_k": out["cache_k"],
-                          "cache_v": out["cache_v"]}
+            self._pool = {k: v for k, v in out.items()
+                          if k.startswith("cache_")}
         with self.registry.atomic():
             self._c_admissions.inc()
             self._c_prefills.inc()
@@ -923,8 +970,8 @@ class GenerationEngine:
             out = self.sw.prefill({
                 "input_ids": ids, "prompt_mask": mask,
                 "table_row": table_row, **self._pool})
-            self._pool = {"cache_k": out["cache_k"],
-                          "cache_v": out["cache_v"]}
+            self._pool = {k: v for k, v in out.items()
+                          if k.startswith("cache_")}
         with self.registry.atomic():
             self._c_admissions.inc()
             self._c_prefills.inc()
@@ -1109,8 +1156,8 @@ class GenerationEngine:
         with span("decode_step", lane="scheduler",
                   slots=int(alive.sum())):
             out = self.sw.decode(feats)
-            self._pool = {"cache_k": out["cache_k"],
-                          "cache_v": out["cache_v"]}
+            self._pool = {k: v for k, v in out.items()
+                          if k.startswith("cache_")}
             logits = np.asarray(out["logits"])   # blocks on the result
         self._retry.observe(time.perf_counter() - t0)
         with self.registry.atomic():
@@ -1159,6 +1206,8 @@ class GenerationEngine:
                 self._g_blocks_free.set(free)
                 self._g_bytes_resident.set(
                     (self.blocks.usable - free) * self._block_bytes)
+                self._g_bytes_resident_peak.set(
+                    self.blocks.peak_in_use * self._block_bytes)
                 if self.prefix_cache is not None:
                     self._g_prefix_entries.set(len(self.prefix_cache))
         return self.registry.snapshot()
@@ -1179,6 +1228,7 @@ class GenerationEngine:
                   if decode_steps else 0.0)
         out = {
             "slots": self.slots,
+            "kv_cache_dtype": self.kv_cache_dtype,
             "live_slots": c("serving_live_slots"),
             "queue_depth": c("serving_queue_depth"),
             "admissions": c("serving_admissions_total"),
@@ -1203,6 +1253,7 @@ class GenerationEngine:
                 "blocks_total": self.blocks.usable,
                 "blocks_free": c("serving_blocks_free"),
                 "bytes_resident": c("serving_bytes_resident"),
+                "bytes_resident_peak": c("serving_bytes_resident_peak"),
                 "prefix_cache_hits": (
                     c("serving_prefix_cache_hits_total")
                     if self.prefix_cache is not None else 0),

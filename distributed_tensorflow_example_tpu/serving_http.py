@@ -133,6 +133,18 @@ class PredictServer:
             "http_requests_total", "HTTP requests handled")
         self._c_http_errors = self.registry.counter(
             "http_errors_total", "HTTP responses with status >= 400")
+        # quant observability: a generator artifact from before the
+        # quant metadata schema can still be served (it simply has no
+        # quantized paths), but the operator should see that it
+        # predates quant support rather than assume --weight_quant /
+        # --kv_cache_dtype took effect
+        self._c_quant_fallback = self.registry.counter(
+            "serving_quant_fallback_total",
+            "generator artifacts loaded without quant metadata "
+            "(exported before the quant schema — no quantized paths)")
+        if (self.servable.meta.get("kind") == "generator"
+                and self.servable.meta.get("quant_schema") is None):
+            self._c_quant_fallback.inc()
         self._request_logger = None
         if request_log:
             from .utils.metrics import MetricsLogger

@@ -70,6 +70,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+#: documented greedy-drift gate for the int8 legs: token-level
+#: agreement with the bf16 oracle over the seeded prompt matrix must
+#: stay at or above this bound (measured 1.0 on the tiny CPU config;
+#: the bound leaves headroom for the gpt-small TPU re-base — see
+#: DESIGN.md §15 "drift gate")
+INT8_MIN_AGREEMENT = 0.75
+
+
+def token_agreement(gens_a, gens_b) -> float:
+    """Token-level agreement between two [client][request][tokens]
+    generation matrices of identical shape: matching positions /
+    compared positions (1.0 when empty)."""
+    agree = total = 0
+    for row_a, row_b in zip(gens_a, gens_b):
+        for ga, gb in zip(row_a, row_b):
+            total += len(ga)
+            agree += sum(int(a == b) for a, b in zip(ga, gb))
+    return agree / total if total else 1.0
+
 
 def _post(port, name, verb, payload, timeout=300):
     req = urllib.request.Request(
@@ -138,12 +157,15 @@ def _pctls(samples_ms):
 def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                  slots: int, seed: int = 0, model_name: str = "gpt_tiny",
                  platforms=("cpu",), paged: bool = False,
-                 block_size: int = 16, num_blocks=None):
+                 block_size: int = 16, num_blocks=None,
+                 weight_quant: str = "off",
+                 kv_cache_dtype: str = "auto", pool_bytes=None):
     """Seeded GPT stepwise export (ragged monolithic artifact too, so
     the off path serves the same mixed prompt lengths). ``platforms``
     includes "tpu" when bench.py runs the serving row on chip;
     ``paged=True`` exports the block-paged stepwise pair instead of
-    the slab pool."""
+    the slab pool. ``weight_quant``/``kv_cache_dtype``/``pool_bytes``
+    pass straight through to ``export_generator`` (the int8 legs)."""
     import jax
     from distributed_tensorflow_example_tpu.config import TrainConfig
     from distributed_tensorflow_example_tpu.models import get_model
@@ -155,6 +177,9 @@ def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                      max_new_tokens=max_new, batch_size=1, ragged=True,
                      stepwise=True, slots=slots, paged=paged,
                      block_size=block_size, num_blocks=num_blocks,
+                     weight_quant=weight_quant,
+                     kv_cache_dtype=kv_cache_dtype,
+                     pool_bytes=pool_bytes,
                      platforms=tuple(platforms))
     return model.cfg.vocab_size
 
@@ -334,6 +359,58 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     return row
 
 
+def int8_capacity_check(*, prompt_len: int, max_new: int, seed: int,
+                        block_size: int) -> tuple[int, int]:
+    """THE equal-bytes capacity probe: export a bf16 and an int8 paged
+    artifact at the SAME K/V pool byte budget, offer each engine a wave
+    of distinct short prompts, and count concurrent admissions. int8
+    halves the per-block payload, so its pool holds 2x the blocks and
+    must admit strictly more requests. Returns ``(bf16_admitted,
+    int8_admitted)``."""
+    import tempfile
+
+    from distributed_tensorflow_example_tpu.serving import load_stepwise
+    from distributed_tensorflow_example_tpu.serving_batch import \
+        GenerationEngine
+
+    total = prompt_len + max_new
+    bps = -(-total // block_size)
+    slots = 16
+    rs = np.random.RandomState(seed + 999)
+    # distinct 2-token prompts (1 block each) — prefix cache off, so
+    # admission counts are pure block-capacity observations
+    prompts = [np.array([i, int(rs.randint(0, 1000))], np.int32)
+               for i in range(slots)]
+    counts = {}
+    pool_bytes = None
+    for dtype in ("bf16", "int8"):
+        with tempfile.TemporaryDirectory() as d:
+            build_export(d, prompt_len=prompt_len, max_new=max_new,
+                         slots=slots, seed=seed, paged=True,
+                         block_size=block_size,
+                         kv_cache_dtype=dtype,
+                         num_blocks=(1 + 2 * bps) if pool_bytes is None
+                         else None,
+                         pool_bytes=pool_bytes)
+            sw = load_stepwise(d)
+            if pool_bytes is None:
+                # the bf16 pool's K/V byte budget = the int8 export's
+                # pool_bytes (block_bytes is pure payload for bf16)
+                m = sw.step_meta
+                pool_bytes = (int(m["num_blocks"]) - 1) \
+                    * int(m["block_bytes"])
+            eng = GenerationEngine(sw, prefix_cache=False)
+            for p in prompts:
+                # max_new=2: a slot stays LIVE after its admission
+                # prefill (max_new=1 retires on the prefill logits),
+                # so len(_live) counts concurrent residency
+                eng.submit(p, max_new=2)
+            eng._admit()
+            counts[dtype] = len(eng._live)
+            eng.close()
+    return counts["bf16"], counts["int8"]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
@@ -352,6 +429,22 @@ def main(argv=None) -> int:
     ap.add_argument("--num_blocks", type=int, default=None,
                     help="paged: physical blocks in the pool (default: "
                     "slab-equivalent capacity + the null block)")
+    ap.add_argument("--pool_bytes", type=int, default=None,
+                    help="paged: size the block pool in BYTES instead "
+                    "of blocks (int8 then holds 2x the bf16 block "
+                    "count at the same budget)")
+    ap.add_argument("--weight_quant", choices=("off", "int8"),
+                    default="off",
+                    help="decode weights: 'int8' bakes per-output-"
+                    "channel int8 + scales into every decode program "
+                    "(LOSSY — gated by the drift bound, not byte "
+                    "parity)")
+    ap.add_argument("--kv_cache_dtype", choices=("auto", "bf16", "int8"),
+                    default="auto",
+                    help="KV-cache pool storage: 'auto' keeps the "
+                    "model dtype (the bitwise no-op), 'int8' stores "
+                    "quantized blocks + per-row scales (requires "
+                    "--paged)")
     ap.add_argument("--prefix_mode", choices=("cold", "shared"),
                     default="cold",
                     help="workload shape: 'shared' prepends one seeded "
@@ -360,15 +453,28 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CPU config: 2 clients x 2 requests, "
                     "tiny shapes; runs the slab on/off pair PLUS the "
-                    "paged cold/shared legs and asserts paged-vs-slab "
-                    "parity and shared-mode prefill savings")
+                    "paged cold/shared legs and an int8 leg (drift "
+                    "bound + equal-bytes capacity), asserting "
+                    "paged-vs-slab parity and shared-mode prefill "
+                    "savings")
     ap.add_argument("--no_parity", action="store_true",
                     help="skip the on-vs-off byte-identity assertion")
     args = ap.parse_args(argv)
+    if args.smoke and (args.weight_quant != "off"
+                       or args.kv_cache_dtype != "auto"):
+        ap.error("--smoke already runs its own fully quantized int8 "
+                 "leg (int8 weights + int8 paged pool, drift bound + "
+                 "capacity probe) — drop --weight_quant/"
+                 "--kv_cache_dtype, or run a full-matrix quant leg "
+                 "without --smoke")
+    if args.kv_cache_dtype == "int8" and not args.paged:
+        ap.error("--kv_cache_dtype int8 quantizes the block-paged "
+                 "pool — add --paged")
     if args.smoke:
         args.clients, args.requests = 2, 2
         args.slots, args.prompt_len, args.max_new = 2, 8, 4
         args.block_size = min(args.block_size, 4)
+    quant = args.weight_quant == "int8" or args.kv_cache_dtype == "int8"
 
     def matrix_for(vocab, prefix_mode):
         return make_requests(args.clients, args.requests,
@@ -380,20 +486,44 @@ def main(argv=None) -> int:
     rows = []
     checks = []          # (description, bool) pairs for the summary
     with tempfile.TemporaryDirectory() as d:
+        # the plain export: the "on" leg when quant is off, and ALWAYS
+        # the scheduler-off bf16 oracle (a quant export's monolithic
+        # artifact rides int8 weights too, so it cannot be the drift
+        # oracle)
         vocab = build_export(d, prompt_len=args.prompt_len,
                              max_new=args.max_new, slots=args.slots,
-                             seed=args.seed, paged=args.paged,
+                             seed=args.seed,
+                             paged=args.paged and not quant,
                              block_size=args.block_size,
-                             num_blocks=args.num_blocks)
+                             num_blocks=None if quant
+                             else args.num_blocks,
+                             pool_bytes=None if quant
+                             else args.pool_bytes)
         matrix = matrix_for(vocab, args.prefix_mode)
         # the exported dir always holds the monolithic artifact too,
         # so scheduler=off is the oracle for slab AND paged runs
-        rows = [run_mode(d, matrix, scheduler="on",
-                         prompt_len=args.prompt_len,
-                         mode_name=("paged_on" if args.paged
-                                    else "scheduler_on")),
-                run_mode(d, matrix, scheduler="off",
-                         prompt_len=args.prompt_len)]
+        if quant:
+            with tempfile.TemporaryDirectory() as dq:
+                build_export(dq, prompt_len=args.prompt_len,
+                             max_new=args.max_new, slots=args.slots,
+                             seed=args.seed, paged=args.paged,
+                             block_size=args.block_size,
+                             num_blocks=args.num_blocks,
+                             pool_bytes=args.pool_bytes,
+                             weight_quant=args.weight_quant,
+                             kv_cache_dtype=args.kv_cache_dtype)
+                rows = [run_mode(dq, matrix, scheduler="on",
+                                 prompt_len=args.prompt_len,
+                                 mode_name="int8_on")]
+            rows.append(run_mode(d, matrix, scheduler="off",
+                                 prompt_len=args.prompt_len))
+        else:
+            rows = [run_mode(d, matrix, scheduler="on",
+                             prompt_len=args.prompt_len,
+                             mode_name=("paged_on" if args.paged
+                                        else "scheduler_on")),
+                    run_mode(d, matrix, scheduler="off",
+                             prompt_len=args.prompt_len)]
         if args.smoke:
             with tempfile.TemporaryDirectory() as dp:
                 build_export(dp, prompt_len=args.prompt_len,
@@ -429,7 +559,32 @@ def main(argv=None) -> int:
                 shared_off = run_mode(dp, shared, scheduler="off",
                                       prompt_len=args.prompt_len,
                                       mode_name="shared_off")
-            rows += [paged_cold, paged_shared, shared_off]
+            # the int8 leg: same cold matrix against a fully quantized
+            # export (int8 weights + int8 KV pool) — gated on the
+            # documented drift bound vs the bf16 oracle, plus the
+            # equal-bytes capacity probe
+            with tempfile.TemporaryDirectory() as di:
+                total = args.prompt_len + args.max_new
+                bps = -(-total // args.block_size)
+                build_export(di, prompt_len=args.prompt_len,
+                             max_new=args.max_new, slots=args.slots,
+                             seed=args.seed, paged=True,
+                             block_size=args.block_size,
+                             num_blocks=1 + 4 * args.slots * bps,
+                             weight_quant="int8",
+                             kv_cache_dtype="int8")
+                int8_row = run_mode(di, cold, scheduler="on",
+                                    prompt_len=args.prompt_len,
+                                    mode_name="int8_on")
+            agreement = token_agreement(int8_row["_gens"],
+                                        cold_off_gens)
+            int8_row["int8_agreement"] = round(agreement, 4)
+            cap_bf16, cap_int8 = int8_capacity_check(
+                prompt_len=args.prompt_len, max_new=args.max_new,
+                seed=args.seed, block_size=args.block_size)
+            int8_row["capacity_bf16"] = cap_bf16
+            int8_row["capacity_int8"] = cap_int8
+            rows += [paged_cold, paged_shared, shared_off, int8_row]
             checks += [
                 ("paged_vs_slab_parity",
                  paged_cold["_gens"] == cold_off_gens),
@@ -439,13 +594,22 @@ def main(argv=None) -> int:
                  paged_shared["prefills"] < paged_cold["prefills"]),
                 ("scheduler_trace_valid",
                  paged_shared.get("trace_events", 0) > 0),
+                ("int8_drift_within_bound",
+                 agreement >= INT8_MIN_AGREEMENT),
+                ("int8_admits_more_than_bf16", cap_int8 > cap_bf16),
             ]
 
-    parity = None
-    if not args.no_parity:
+    parity = agreement = None
+    if quant:
+        # int8 vs the bf16 oracle: byte parity is not the contract —
+        # the documented token-agreement bound is
+        agreement = round(token_agreement(rows[0]["_gens"],
+                                          rows[1]["_gens"]), 4)
+    elif not args.no_parity:
         parity = rows[0]["_gens"] == rows[1]["_gens"]
     ok = (all(not r["errors"] for r in rows)
           and parity is not False
+          and (agreement is None or agreement >= INT8_MIN_AGREEMENT)
           and all(v for _, v in checks))
     for row in rows:
         row.pop("_gens")
@@ -462,6 +626,9 @@ def main(argv=None) -> int:
             off["decode_steps"] / on["decode_steps"], 3)
         if on["decode_steps"] else None,
     }
+    if agreement is not None:
+        summary["int8_agreement"] = agreement
+        summary["int8_agreement_bound"] = INT8_MIN_AGREEMENT
     summary.update({name: v for name, v in checks})
     print(json.dumps(summary))
     return 0 if ok else 1

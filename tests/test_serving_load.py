@@ -27,7 +27,7 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert summary[0]["greedy_parity"] is True
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
-                          "paged_shared", "shared_off"}
+                          "paged_shared", "shared_off", "int8_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -44,6 +44,15 @@ def test_smoke_runs_and_holds_parity(capsys):
             < modes["paged_cold"]["prefills"])
     assert modes["paged_shared"]["prefix_cache_hits"] > 0
     assert modes["paged_shared"]["prefill_tokens_saved"] > 0
+    # round-12 int8 leg: drift within the documented bound and the
+    # equal-bytes capacity probe admits strictly more than bf16
+    assert s["int8_drift_within_bound"] is True
+    assert s["int8_admits_more_than_bf16"] is True
+    i8 = modes["int8_on"]
+    assert not i8["errors"]
+    assert i8["int8_agreement"] >= 0.75
+    assert i8["capacity_int8"] > i8["capacity_bf16"]
+    assert i8["registry"]["serving_bytes_resident_peak"] > 0
 
 
 def test_bench_serving_row_publishes_keys():
@@ -60,6 +69,16 @@ def test_bench_serving_row_publishes_keys():
     assert row["serving_paged_errors"] == 0
     assert 0.0 <= row["serving_prefix_hit_rate"] <= 1.0
     assert row["serving_paged_tps"] > 0
+    # round-12 int8 columns for next-window TPU baselining
+    assert row["serving_int8_tps"] > 0
+    assert row["serving_int8_errors"] == 0
+    assert 0.0 <= row["serving_int8_drift_rate"] <= 1.0
+    assert row["serving_bytes_resident_peak"] > 0
+    assert row["serving_int8_bytes_resident_peak"] > 0
+    # equal workload, int8 pool: the peak resident bytes must come in
+    # BELOW the bf16 paged leg's (the capacity lever's observable)
+    assert (row["serving_int8_bytes_resident_peak"]
+            < row["serving_bytes_resident_peak"])
 
 
 @pytest.mark.slow
