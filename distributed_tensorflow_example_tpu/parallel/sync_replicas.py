@@ -184,9 +184,12 @@ class SyncReplicas:
             # and error plumbing per step; no donation (checkify rewrites
             # the jaxpr and aliasing is not worth fighting here).
             from jax.experimental import checkify
-            checked = jax.jit(checkify.checkify(
+            # deliberately un-donated (see docstring above): checkify
+            # rewrites the jaxpr and buffer aliasing is not worth
+            # fighting on a debug-only path
+            checked = jax.jit(checkify.checkify(       # graftlint: disable=DON01
                 step_fn, errors=checkify.float_checks))
-            checked_multi = jax.jit(checkify.checkify(
+            checked_multi = jax.jit(checkify.checkify(  # graftlint: disable=DON01
                 self._multi_step, errors=checkify.float_checks))
 
             def step_with_checks(state, batch):

@@ -108,6 +108,153 @@ class BlocksExhaustedError(Exception):
     loudly; the engine keeps serving its neighbors."""
 
 
+# ---------------------------------------------------------------------------
+# thread-ownership discipline: markers + debug sanitizer (round 13)
+#
+# The engine's correctness rests on ONE invariant no test used to pin
+# directly: the scheduler thread alone touches the pool, the live-slot
+# map, the block allocator, and the prefix cache. The markers below
+# DECLARE that ownership so tools/graftlint's THR01 rule can check it
+# statically (a method referencing an owned field must be
+# @scheduler_thread, or @snapshot_view and read-only), and the optional
+# runtime sanitizer enforces it on every attribute access in debug runs.
+# ---------------------------------------------------------------------------
+
+class ThreadOwnershipError(AssertionError):
+    """A scheduler-owned field was touched from a foreign thread — the
+    exact race class the single-flight scheduler design exists to make
+    impossible. Raised only under ``thread_sanitizer=True``."""
+
+
+def scheduler_owned(*fields: str):
+    """Class decorator declaring which fields ONLY the scheduler thread
+    may touch (cross-thread readers go through the snapshot views).
+    Pure metadata at runtime until ``thread_sanitizer=True`` swaps the
+    instance onto a subclass with guarded descriptors."""
+    def deco(cls):
+        cls.__scheduler_owned__ = tuple(fields)
+        return cls
+    return deco
+
+
+def scheduler_thread(fn):
+    """Marks a method as running on the engine's scheduler thread (full
+    access to ``@scheduler_owned`` fields). Metadata for graftlint's
+    THR01 rule — no runtime behavior."""
+    fn.__scheduler_thread__ = True
+    return fn
+
+
+def snapshot_view(fn):
+    """Marks a method as a cross-thread SNAPSHOT VIEW: it may READ
+    scheduler-owned fields (never write). The wrapper holds the
+    instance's view context manager for the call — a no-op object when
+    the sanitizer is off, the thread-local read allowance when armed —
+    so the method body itself stays sanitizer-unaware."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._san_view_cm:
+            return fn(self, *args, **kwargs)
+    wrapper.__snapshot_view__ = True
+    return wrapper
+
+
+_SAN_TL = threading.local()
+
+
+class _SnapshotReads:
+    """Context manager a @snapshot_view method holds while reading
+    owned fields: flips the thread-local read allowance the guarded
+    descriptors honor (re-entrant via a depth counter)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _SAN_TL.allow_reads = getattr(_SAN_TL, "allow_reads", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _SAN_TL.allow_reads -= 1
+        return False
+
+
+class _NoopCM:
+    """The disabled path's stand-in — one branchless no-op per view,
+    mirroring the obs.registry disabled-registry pattern."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_SNAPSHOT_READS = _SnapshotReads()
+_NOOP_CM = _NoopCM()
+
+
+class _GuardedAttr:
+    """Data descriptor standing in for one scheduler-owned field when
+    the sanitizer is armed: every read/write asserts the caller IS the
+    scheduler thread (or, for reads, inside a snapshot view). The value
+    itself lives in the instance ``__dict__`` as before."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _check(self, obj, mode: str) -> None:
+        tid = obj.__dict__.get("_san_tid")
+        if tid is None or threading.get_ident() == tid:
+            return
+        if mode == "read" and getattr(_SAN_TL, "allow_reads", 0):
+            return
+        raise ThreadOwnershipError(
+            f"scheduler-owned field `{type(obj).__name__}.{self.name}` "
+            f"{mode} from thread {threading.current_thread().name!r} "
+            f"(ident {threading.get_ident()}); only the scheduler "
+            f"thread (ident {tid}) owns it — cross-thread readers go "
+            "through the snapshot views (stats/metrics_snapshot)")
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        self._check(obj, "read")
+        try:
+            return obj.__dict__[self.name]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+    def __set__(self, obj, value):
+        self._check(obj, "write")
+        obj.__dict__[self.name] = value
+
+    def __delete__(self, obj):
+        self._check(obj, "write")
+        del obj.__dict__[self.name]
+
+
+_SANITIZED_CLASSES: dict[type, type] = {}
+
+
+def _sanitized_class(cls: type) -> type:
+    """Per-base-class cached subclass with a :class:`_GuardedAttr` per
+    ``@scheduler_owned`` field. Instances opt in by swapping their
+    ``__class__`` — so with the sanitizer OFF the engine keeps its
+    plain class and plain attributes: zero overhead, not even a branch,
+    on the hot decode path."""
+    sub = _SANITIZED_CLASSES.get(cls)
+    if sub is None:
+        ns = {f: _GuardedAttr(f)
+              for f in getattr(cls, "__scheduler_owned__", ())}
+        sub = type(cls.__name__ + "ThreadSanitized", (cls,), ns)
+        _SANITIZED_CLASSES[cls] = sub
+    return sub
+
+
 class BlockPool:
     """Host-side refcounted allocator over the physical blocks of a
     paged KV-cache pool.
@@ -425,18 +572,27 @@ class _Slot:
                                       - len(self.tokens))
 
 
+@scheduler_owned("_pool", "_live", "_free", "_admitting", "_tables",
+                 "blocks", "prefix_cache", "_slot_freed_t", "_retry",
+                 "_steps_to_free_hint")
 class GenerationEngine:
     """The continuous-batching scheduler (see module docstring).
 
     ``submit`` is thread-safe (called from HTTP handler threads); all
     executable calls happen on the single scheduler thread, so the
-    engine is also the generate path's single-flight discipline.
+    engine is also the generate path's single-flight discipline. The
+    ``@scheduler_owned`` fields above are that discipline made
+    explicit: only ``@scheduler_thread`` methods may touch them
+    (``@snapshot_view`` methods may read), checked statically by
+    graftlint's THR01 rule and — under ``thread_sanitizer=True`` — on
+    every attribute access at runtime (a debug mode; disabled, the
+    class is untouched and the hot path pays nothing).
     """
 
     def __init__(self, stepwise: StepwiseGenerator, *,
                  max_queue: int = 64, prefix_cache: bool = True,
                  registry: Registry | None = None,
-                 metrics_logger=None):
+                 metrics_logger=None, thread_sanitizer: bool = False):
         self.sw = stepwise
         m = stepwise.step_meta
         self.slots: int = int(m["slots"])
@@ -581,6 +737,17 @@ class GenerationEngine:
             "bytes one cached token occupies at the artifact's "
             "kv_cache_dtype (K+V payload plus int8 scale rows)")
         self._g_kv_bytes_per_token.set(tok_bytes)
+        # ---- thread-ownership sanitizer (debug): swap onto the
+        # guarded subclass LAST so __init__'s own stores stay plain.
+        # The owner tid arms when the scheduler thread starts; until
+        # then (tests pre-loading state, direct _admit() calls) every
+        # thread passes. Disabled: no class swap, zero overhead.
+        self.thread_sanitizer = thread_sanitizer
+        self._san_tid: int | None = None
+        self._san_view_cm = _NOOP_CM
+        if thread_sanitizer:
+            self._san_view_cm = _SNAPSHOT_READS
+            self.__class__ = _sanitized_class(type(self))
 
     @staticmethod
     def _make_block_copy():
@@ -737,6 +904,7 @@ class GenerationEngine:
         """Blocking convenience wrapper: submit + wait."""
         return self.submit(prompt, **kw).result(timeout)
 
+    @snapshot_view
     def _retry_after(self) -> float:
         """Retry-After from the measured decode-step EMA × estimated
         steps until a slot frees × the admission waves the current
@@ -765,25 +933,37 @@ class GenerationEngine:
             self._running = False
             self._closed = True
             self._cond.notify_all()
+        joined = True
         if self._thread is not None:
             self._thread.join(timeout=10)
+            joined = not self._thread.is_alive()
             self._thread = None
+        # the scheduler thread is joined: ownership reverts to the
+        # closing thread (disarm the sanitizer, THR01 suppressed below
+        # for the same reason — these accesses are post-join teardown).
+        # A TIMED-OUT join keeps the sanitizer armed: the scheduler is
+        # still running, so the teardown below racing it is exactly the
+        # violation class the sanitizer exists to raise on.
+        if joined:
+            self._san_tid = None
         # fail whatever never got scheduled — a hung client is worse
         # than a clear error
         err = RuntimeError("generation engine stopped")
         with self._cond:
             self._c_requests_failed.inc(len(self._queue)
-                                        + len(self._live))
+                                        + len(self._live))  # graftlint: disable=THR01
             for req in self._queue:
                 req.future.set_exception(err)
             self._queue.clear()
             self._g_queue_depth.set(0)
-            for slot in self._live.values():
+            for slot in self._live.values():  # graftlint: disable=THR01
                 slot.req.future.set_exception(err)
-            self._live.clear()
+            self._live.clear()  # graftlint: disable=THR01
             self._g_live_slots.set(0)
 
+    @scheduler_thread
     def _loop(self) -> None:
+        self._san_tid = threading.get_ident()
         while True:
             with self._cond:
                 while (self._running and not self._queue
@@ -829,6 +1009,7 @@ class GenerationEngine:
                             self.blocks, self.block_size,
                             registry=self.registry)
 
+    @scheduler_thread
     def _admit(self) -> None:
         """Drain the queue into free slots. Runs between shared steps —
         admission joins mid-flight. Slab path: one prefill dispatch per
@@ -866,6 +1047,7 @@ class GenerationEngine:
                 if not admitted:
                     return
 
+    @scheduler_thread
     def _admit_slab(self, req: GenRequest, index: int) -> None:
         ids = np.zeros((1, self.prompt_len), np.int32)
         mask = np.zeros((1, self.prompt_len), np.int32)
@@ -888,6 +1070,7 @@ class GenerationEngine:
         tok = self._pick(slot, np.asarray(out["logits"])[0])
         self._emit(slot, tok)
 
+    @scheduler_thread
     def _admit_paged(self, req: GenRequest, index: int) -> bool:
         """Paged admission; returns False when block pressure defers
         the request (re-queued at the head, slot index returned)."""
@@ -986,6 +1169,7 @@ class GenerationEngine:
         self._emit(slot, tok)
         return True
 
+    @scheduler_thread
     def _release_slot_blocks(self, index: int) -> None:
         """Retirement/failure: drop this slot's table references (a
         block shared with the prefix cache or another slot survives —
@@ -997,6 +1181,7 @@ class GenerationEngine:
             self.blocks.release(ids)
         row[:] = 0
 
+    @scheduler_thread
     def _fail_slot(self, slot: _Slot, err: Exception) -> None:
         """Fail ONE live request loudly (mid-decode block exhaustion)
         without disturbing its neighbors."""
@@ -1009,6 +1194,7 @@ class GenerationEngine:
         self._slot_freed_t[slot.index] = time.perf_counter()
         slot.req.future.set_exception(err)
 
+    @scheduler_thread
     def _ensure_write_block(self, slot: _Slot) -> None:
         """Before a decode step writes at ``slot.pos``: allocate-on-
         write when the target table entry is still the null block, and
@@ -1051,6 +1237,7 @@ class GenerationEngine:
         g = slot.rng.gumbel(size=scaled.shape)
         return int(np.argmax(scaled + g))
 
+    @scheduler_thread
     def _emit(self, slot: _Slot, tok: int) -> None:
         """Record one sampled token; retire or keep the slot live."""
         slot.tokens.append(tok)
@@ -1070,6 +1257,7 @@ class GenerationEngine:
         else:
             self._live[slot.index] = slot
 
+    @scheduler_thread
     def _retire(self, slot: _Slot, toks: list[int]) -> None:
         """Retirement: timings breakdown, spans, counters, slot free,
         and ONLY THEN the future resolution (a client that wakes on the
@@ -1123,6 +1311,7 @@ class GenerationEngine:
         if self.metrics_logger is not None:
             self.metrics_logger.log({"event": "generate", **req.timings})
 
+    @scheduler_thread
     def _shared_step(self) -> None:
         """ONE batched decode step for every live slot."""
         if self.paged:
@@ -1191,6 +1380,7 @@ class GenerationEngine:
             min(s.remaining_steps() for s in live) if live else 1.0)
 
     # ---- observability ----------------------------------------------
+    @snapshot_view
     def metrics_snapshot(self) -> dict:
         """ONE atomic registry snapshot, gauges freshened first — the
         backing read for both ``/stats`` and ``/metrics`` (so their
@@ -1212,6 +1402,7 @@ class GenerationEngine:
                     self._g_prefix_entries.set(len(self.prefix_cache))
         return self.registry.snapshot()
 
+    @snapshot_view
     def stats(self, snapshot: dict | None = None) -> dict:
         """The legacy ``/stats`` dict — now a pure VIEW of the registry
         snapshot (pass one in to share it with a ``/metrics`` render of

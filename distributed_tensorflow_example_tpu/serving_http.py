@@ -119,7 +119,8 @@ class PredictServer:
                  batch_max_wait_ms: float = 5.0, max_queue: int = 64,
                  prefix_cache: bool = True, metrics: bool = True,
                  trace_buffer_events: int = 65536,
-                 request_log: str | None = None):
+                 request_log: str | None = None,
+                 thread_sanitizer: bool = False):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
@@ -167,6 +168,17 @@ class PredictServer:
             # to keep the plain server a pure parity tool
             scheduler = "on" if (is_gen and stepwise) else "off"
         self.scheduler = scheduler
+        if thread_sanitizer and not (scheduler == "on" and is_gen):
+            # checked BEFORE anything starts: a raise must not leave a
+            # running batcher behind
+            raise ValueError(
+                "thread_sanitizer=True guards the GenerationEngine's "
+                "scheduler-owned fields, but this server would run the "
+                f"{'predict/MicroBatcher' if not is_gen else 'plain'} "
+                f"path (scheduler {scheduler!r}, kind "
+                f"{self.servable.meta.get('kind')!r}) where nothing is "
+                "guarded — drop the flag or serve stepwise generator "
+                "artifacts with scheduler on/auto")
         self.engine: GenerationEngine | None = None
         self.batcher: MicroBatcher | None = None
         if scheduler == "on":
@@ -181,7 +193,8 @@ class PredictServer:
                 self.engine = GenerationEngine(
                     load_stepwise(export_dir), max_queue=max_queue,
                     prefix_cache=prefix_cache, registry=self.registry,
-                    metrics_logger=self._request_logger).start()
+                    metrics_logger=self._request_logger,
+                    thread_sanitizer=thread_sanitizer).start()
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
@@ -731,6 +744,12 @@ def main(argv=None) -> int:
                     help="append one JSONL event per retired :generate "
                     "request (request_id + queue/prefill/decode ms) "
                     "to this path")
+    ap.add_argument("--thread_sanitizer", action="store_true",
+                    help="debug: assert the scheduler thread-ownership "
+                    "discipline on every guarded engine attribute "
+                    "access (a foreign-thread touch raises "
+                    "ThreadOwnershipError naming the field and thread; "
+                    "off = the engine class is untouched)")
     args = ap.parse_args(argv)
     srv = PredictServer(args.export_dir, name=args.name, host=args.host,
                         port=args.port, scheduler=args.scheduler,
@@ -740,7 +759,8 @@ def main(argv=None) -> int:
                         prefix_cache=args.prefix_cache == "on",
                         metrics=args.metrics == "on",
                         trace_buffer_events=args.trace_buffer_events,
-                        request_log=args.request_log)
+                        request_log=args.request_log,
+                        thread_sanitizer=args.thread_sanitizer)
     print(f"serving {srv.name!r} on http://{args.host}:{srv.port}"
           f"/v1/models/{srv.name}:predict", flush=True)
     srv.serve()

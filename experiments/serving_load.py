@@ -55,6 +55,15 @@ paged cold/shared legs, asserting paged-vs-slab byte parity,
 shared-vs-cold admission byte parity, shared-mode prefill dispatches
 strictly below cold-mode, and the scheduler-trace capture; the full
 matrix is registered as a ``slow`` test (tests/test_serving_load.py).
+
+Round 13 — thread-ownership sanitizer: ``--thread_sanitizer`` arms the
+engine's THR01 runtime checks (every scheduler-owned attribute access
+asserts the owning thread) on the scheduler-on legs, and ``--smoke``
+always runs a ``tsan_on`` leg asserting the ARMED engine stays byte-
+and dispatch-identical to the plain leg (the disabled default provably
+adds zero dispatches) plus a seeded cross-thread violation probe
+(:func:`thread_sanitizer_check`) proving the sanitizer names the
+offending field and thread.
 """
 
 import argparse
@@ -230,10 +239,15 @@ def make_requests(clients: int, requests: int, *, prompt_len: int,
 
 def run_mode(export_dir: str, matrix, *, scheduler: str,
              prompt_len: int, mode_name: str | None = None,
-             prefix_cache: bool = True, trace: bool = False) -> dict:
+             prefix_cache: bool = True, trace: bool = False,
+             thread_sanitizer: bool = False) -> dict:
     """Drive one server mode with the closed-loop client matrix;
     returns the result row (and stashes per-request generations under
-    ``_gens`` for the parity check)."""
+    ``_gens`` for the parity check). ``thread_sanitizer=True`` arms the
+    engine's THR01 runtime ownership checks for the whole leg — a
+    cross-thread touch of a scheduler-owned field fails the run
+    loudly, and the row must stay byte- and dispatch-identical to the
+    unarmed leg (asserted by the --smoke checks)."""
     from distributed_tensorflow_example_tpu.serving_http import PredictServer
 
     clients = len(matrix)
@@ -243,7 +257,8 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     request_ids: list[str] = []
     errors: list[str] = []
     with PredictServer(export_dir, scheduler=scheduler,
-                       prefix_cache=prefix_cache) as srv:
+                       prefix_cache=prefix_cache,
+                       thread_sanitizer=thread_sanitizer) as srv:
         def client(ci):
             for prompt, m in matrix[ci]:
                 if scheduler == "on":
@@ -411,6 +426,36 @@ def int8_capacity_check(*, prompt_len: int, max_new: int, seed: int,
     return counts["bf16"], counts["int8"]
 
 
+def thread_sanitizer_check(export_dir: str, prompt) -> tuple[bool, str]:
+    """The seeded THR01 violation probe: arm an engine's runtime
+    thread sanitizer, let the scheduler thread take ownership (one
+    request through the fully legal path first), then touch a
+    scheduler-owned field from THIS thread — the exact cross-thread
+    mutation class the single-flight design forbids. Returns
+    ``(caught, message)``: ``caught`` is True only when the sanitizer
+    raised :class:`ThreadOwnershipError` naming both the field and the
+    offending thread."""
+    from distributed_tensorflow_example_tpu.serving import load_stepwise
+    from distributed_tensorflow_example_tpu.serving_batch import (
+        GenerationEngine, ThreadOwnershipError)
+
+    eng = GenerationEngine(load_stepwise(export_dir),
+                           thread_sanitizer=True).start()
+    try:
+        # legal traffic first: the armed engine must serve it clean
+        eng.submit(prompt, max_new=2).result(timeout=120)
+        try:
+            eng._live            # noqa: B018 — the seeded violation
+        except ThreadOwnershipError as e:
+            msg = str(e)
+            named = ("_live" in msg
+                     and threading.current_thread().name in msg)
+            return named, msg
+        return False, "cross-thread read of _live went unchallenged"
+    finally:
+        eng.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
@@ -453,12 +498,19 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 CPU config: 2 clients x 2 requests, "
                     "tiny shapes; runs the slab on/off pair PLUS the "
-                    "paged cold/shared legs and an int8 leg (drift "
-                    "bound + equal-bytes capacity), asserting "
+                    "paged cold/shared legs, an int8 leg (drift "
+                    "bound + equal-bytes capacity), and a THR01 "
+                    "thread-sanitizer leg (armed byte/dispatch parity "
+                    "+ seeded cross-thread violation probe), asserting "
                     "paged-vs-slab parity and shared-mode prefill "
                     "savings")
     ap.add_argument("--no_parity", action="store_true",
                     help="skip the on-vs-off byte-identity assertion")
+    ap.add_argument("--thread_sanitizer", action="store_true",
+                    help="arm the engine's THR01 runtime ownership "
+                    "checks on every scheduler-on leg (debug; --smoke "
+                    "always runs its own armed leg + seeded-violation "
+                    "probe)")
     args = ap.parse_args(argv)
     if args.smoke and (args.weight_quant != "off"
                        or args.kv_cache_dtype != "auto"):
@@ -470,6 +522,11 @@ def main(argv=None) -> int:
     if args.kv_cache_dtype == "int8" and not args.paged:
         ap.error("--kv_cache_dtype int8 quantizes the block-paged "
                  "pool — add --paged")
+    if args.smoke and args.thread_sanitizer:
+        ap.error("--smoke already runs its own armed tsan_on leg AND "
+                 "needs rows[0] unarmed for the armed-vs-unarmed "
+                 "parity/zero-dispatch checks — arming every leg would "
+                 "make them vacuous; drop --thread_sanitizer")
     if args.smoke:
         args.clients, args.requests = 2, 2
         args.slots, args.prompt_len, args.max_new = 2, 8, 4
@@ -514,14 +571,16 @@ def main(argv=None) -> int:
                              kv_cache_dtype=args.kv_cache_dtype)
                 rows = [run_mode(dq, matrix, scheduler="on",
                                  prompt_len=args.prompt_len,
-                                 mode_name="int8_on")]
+                                 mode_name="int8_on",
+                                 thread_sanitizer=args.thread_sanitizer)]
             rows.append(run_mode(d, matrix, scheduler="off",
                                  prompt_len=args.prompt_len))
         else:
             rows = [run_mode(d, matrix, scheduler="on",
                              prompt_len=args.prompt_len,
                              mode_name=("paged_on" if args.paged
-                                        else "scheduler_on")),
+                                        else "scheduler_on"),
+                             thread_sanitizer=args.thread_sanitizer),
                     run_mode(d, matrix, scheduler="off",
                              prompt_len=args.prompt_len)]
         if args.smoke:
@@ -584,8 +643,28 @@ def main(argv=None) -> int:
                 seed=args.seed, block_size=args.block_size)
             int8_row["capacity_bf16"] = cap_bf16
             int8_row["capacity_int8"] = cap_int8
-            rows += [paged_cold, paged_shared, shared_off, int8_row]
+            # THR01 runtime-sanitizer legs: the ARMED engine must
+            # serve the same matrix byte- and dispatch-identically to
+            # the plain leg (rows[0] — so the disabled default
+            # provably adds/loses zero dispatches), and the seeded
+            # cross-thread violation probe must be caught with the
+            # field + thread named in the error
+            tsan_row = run_mode(d, matrix, scheduler="on",
+                                prompt_len=args.prompt_len,
+                                mode_name="tsan_on",
+                                thread_sanitizer=True)
+            tsan_caught, _tsan_msg = thread_sanitizer_check(
+                d, matrix[0][0][0])
+            tsan_row["tsan_violation_caught"] = tsan_caught
+            rows += [paged_cold, paged_shared, shared_off, int8_row,
+                     tsan_row]
             checks += [
+                ("tsan_parity_with_unarmed",
+                 tsan_row["_gens"] == rows[0]["_gens"]),
+                ("tsan_zero_dispatch_delta",
+                 (tsan_row["decode_steps"], tsan_row["prefills"])
+                 == (rows[0]["decode_steps"], rows[0]["prefills"])),
+                ("tsan_catches_cross_thread", tsan_caught),
                 ("paged_vs_slab_parity",
                  paged_cold["_gens"] == cold_off_gens),
                 ("shared_vs_cold_admission_parity",

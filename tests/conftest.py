@@ -69,8 +69,24 @@ def build_telemetry_summary() -> str:
     return line
 
 
+def build_graftlint_summary() -> str:
+    """One-line graftlint summary for the tier-1 banner: rule count,
+    finding count (tier-1 requires 0 — tests/test_graftlint.py is the
+    enforcing test; this line is the at-a-glance view), suppression
+    count (pinned by docs/graftlint_suppressions.txt — growth without
+    documentation fails the drift guard), and the baseline size
+    (guarded to stay 0). Pure-stdlib AST analysis, so the banner adds
+    no jax work to the run."""
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.graftlint import lint_paths
+    return lint_paths().summary_line()
+
+
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
-    """Known-failure-set drift banner + tier-1 telemetry summary.
+    """Known-failure-set drift banner + tier-1 telemetry/lint summary.
 
     Drift: tier-1 carries a documented pre-existing failure set
     (docs/known_failures.txt); any failure NOT on that list is flagged
@@ -81,14 +97,23 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
 
     Telemetry: one line naming registry metrics the whole suite never
     incremented (the dead-counter lint — see
-    ``build_telemetry_summary``)."""
+    ``build_telemetry_summary``), and one graftlint line (static
+    invariant rules + suppression inventory — the static complement of
+    the dead-counter lint; see ``build_graftlint_summary``)."""
     try:
         tele = build_telemetry_summary()
     except Exception:           # the lint must never mask test results
         tele = ""
-    if tele:
+    try:
+        lint = build_graftlint_summary()
+    except Exception:
+        lint = ""
+    if tele or lint:
         terminalreporter.section("TIER-1 TELEMETRY", sep="-")
-        terminalreporter.line(tele)
+        if tele:
+            terminalreporter.line(tele)
+        if lint:
+            terminalreporter.line(lint)
     failed = [r.nodeid for r in terminalreporter.stats.get("failed", [])]
     if not failed:
         return

@@ -27,7 +27,8 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert summary[0]["greedy_parity"] is True
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
-                          "paged_shared", "shared_off", "int8_on"}
+                          "paged_shared", "shared_off", "int8_on",
+                          "tsan_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -53,6 +54,29 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert i8["int8_agreement"] >= 0.75
     assert i8["capacity_int8"] > i8["capacity_bf16"]
     assert i8["registry"]["serving_bytes_resident_peak"] > 0
+    # round-13 THR01 leg: the ARMED engine serves the matrix byte- and
+    # dispatch-identically to the plain leg (zero-dispatch-delta
+    # acceptance), and the seeded cross-thread probe is caught
+    assert s["tsan_parity_with_unarmed"] is True
+    assert s["tsan_zero_dispatch_delta"] is True
+    assert s["tsan_catches_cross_thread"] is True
+    tsan = modes["tsan_on"]
+    assert not tsan["errors"]
+    assert tsan["tsan_violation_caught"] is True
+    assert (tsan["decode_steps"], tsan["prefills"]) == (
+        modes["scheduler_on"]["decode_steps"],
+        modes["scheduler_on"]["prefills"])
+
+
+def test_smoke_rejects_thread_sanitizer_flag(capsys):
+    """Regression: --smoke --thread_sanitizer would arm rows[0] too,
+    turning the armed-vs-unarmed parity/zero-dispatch checks into
+    armed-vs-armed (vacuous) — the combo is rejected at parse time,
+    same as the quant flags."""
+    import serving_load
+    with pytest.raises(SystemExit):
+        serving_load.main(["--smoke", "--thread_sanitizer"])
+    assert "vacuous" in capsys.readouterr().err
 
 
 def test_bench_serving_row_publishes_keys():

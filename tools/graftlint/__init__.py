@@ -1,0 +1,20 @@
+"""graftlint: invariant-checking static analysis for this repo.
+
+``python -m tools.graftlint [--changed] [--json] [paths...]`` runs the
+rule set (JIT01, DON01, THR01, OBS01, CFG01 — see
+:mod:`tools.graftlint.rules`) over the package and experiments; tier-1
+requires a clean run (tests/test_graftlint.py).
+"""
+
+from .engine import (BASELINE_PATH, DEFAULT_ROOTS, SUPPRESSIONS_PATH,
+                     Finding, LintResult, lint_paths, lint_source,
+                     lint_sources, load_documented_suppressions,
+                     load_files, suppression_inventory)
+from .rules import ALL_RULES, RULES_BY_NAME
+
+__all__ = [
+    "ALL_RULES", "BASELINE_PATH", "DEFAULT_ROOTS", "Finding",
+    "LintResult", "RULES_BY_NAME", "SUPPRESSIONS_PATH", "lint_paths",
+    "lint_source", "lint_sources", "load_documented_suppressions",
+    "load_files", "suppression_inventory",
+]

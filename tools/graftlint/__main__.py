@@ -1,0 +1,90 @@
+"""CLI: ``python -m tools.graftlint [--changed] [--json] [paths...]``.
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import engine
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description=("invariant-checking static analysis: JIT01 (jit "
+                     "purity), DON01 (train-step donation), THR01 "
+                     "(scheduler thread ownership), OBS01 (registered "
+                     "metric names), CFG01 (dead config knobs). "
+                     "Suppress one line with '# graftlint: "
+                     "disable=RULE' plus a reason comment."))
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs relative to the repo root "
+                    "(default: the package + experiments/)")
+    ap.add_argument("--changed", action="store_true",
+                    help="report only findings in files that differ "
+                    "from git HEAD (analysis still covers the full "
+                    "surface, so cross-file rules stay sound)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON object)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore tools/graftlint/baseline.json")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite baseline.json from the current "
+                    "findings (emergency use; tier-1 pins it empty)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    from .rules import ALL_RULES
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name}  {r.doc}")
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        result = engine.lint_paths(
+            args.paths or None, rules=rules, changed=args.changed,
+            use_baseline=not (args.no_baseline or args.write_baseline))
+    except (ValueError, OSError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        entries = [f.as_dict() for f in result.findings]
+        for e in entries:
+            e.pop("line", None)
+        with open(engine.BASELINE_PATH, "w", encoding="utf-8") as f:
+            json.dump(entries, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"graftlint: wrote {len(entries)} baseline entr"
+              f"{'y' if len(entries) == 1 else 'ies'} to "
+              f"{engine.BASELINE_PATH}")
+        return 0
+
+    problems = result.parse_errors + result.findings
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in problems],
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+            "files": result.files,
+            "rules": result.rule_names,
+            "per_rule": result.per_rule(),
+            "clean": result.clean,
+        }, indent=1, sort_keys=True))
+    else:
+        for f in problems:
+            print(f.render())
+        print(result.summary_line())
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

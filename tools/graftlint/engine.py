@@ -1,0 +1,362 @@
+"""graftlint engine: file discovery, rule driving, suppressions, baseline.
+
+The repo's correctness contracts (jit purity, train-step donation, the
+single-flight scheduler thread, registry-only metrics, no dead config
+knobs) were enforced by convention plus one regression test each. This
+engine machine-checks them: every rule in :mod:`tools.graftlint.rules`
+walks the package's ASTs and reports :class:`Finding`\\ s; tier-1 runs
+the whole lint and requires zero.
+
+Escape hatches, in order of preference:
+
+- fix the code (the default — a finding is a contract violation);
+- a **commented suppression** on the offending line::
+
+      self._live.clear()   # graftlint: disable=THR01  (thread joined)
+
+  Every suppression site is inventoried and pinned by
+  ``docs/graftlint_suppressions.txt`` — adding one without updating the
+  inventory fails tier-1 loudly (tests/test_graftlint.py);
+- the **baseline** (``tools/graftlint/baseline.json``): a list of
+  finding fingerprints filtered from the report. It exists for
+  emergencies (landing the lint over a tree with unfixable findings)
+  and is guarded to stay EMPTY — prefer suppressions, which live next
+  to the code they excuse.
+
+Pure stdlib on purpose: the lint must run (and run fast) without a jax
+backend, in CI, and inside the tier-1 terminal banner.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import subprocess
+from typing import Iterable, Sequence
+
+#: repo root = the directory holding tools/ (engine.py is tools/graftlint/)
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+#: default lint surface: the package + the experiment harnesses
+DEFAULT_ROOTS = ("distributed_tensorflow_example_tpu", "experiments")
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+#: documented suppression inventory (the drift guard's pin — same
+#: pattern as docs/known_failures.txt for the known-failure set)
+SUPPRESSIONS_PATH = os.path.join(REPO_ROOT, "docs",
+                                 "graftlint_suppressions.txt")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    symbol: str        # enclosing qualname ("" = module level)
+    message: str
+
+    def fingerprint(self) -> tuple[str, str, str, str]:
+        """Line-number-free identity (baseline matching must survive
+        unrelated edits shifting lines)."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym} {self.message}"
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed lint input."""
+
+    path: str                  # repo-relative
+    src: str
+    tree: ast.Module
+    lines: list[str]
+
+    @classmethod
+    def from_source(cls, src: str, path: str) -> "SourceFile":
+        return cls(path=path, src=src, tree=ast.parse(src),
+                   lines=src.splitlines())
+
+
+@dataclasses.dataclass
+class LintResult:
+    """Everything one lint run produced."""
+
+    findings: list[Finding]
+    suppressed: list[Finding]
+    baselined: list[Finding]
+    parse_errors: list[Finding]
+    files: int
+    rule_names: list[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def per_rule(self) -> dict[str, int]:
+        out = {name: 0 for name in self.rule_names}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def summary_line(self) -> str:
+        counts = self.per_rule()
+        hot = ", ".join(f"{r}={n}" for r, n in sorted(counts.items())
+                        if n)
+        total = len(self.findings) + len(self.parse_errors)
+        line = (f"GRAFTLINT: {len(self.rule_names)} rule(s) over "
+                f"{self.files} file(s), {total} finding(s)")
+        if hot:
+            line += f" ({hot})"
+        line += (f", {len(self.suppressed)} suppression(s), "
+                 f"baseline {len(self.baselined)}")
+        return line
+
+
+# ---------------------------------------------------------------------------
+# file discovery
+# ---------------------------------------------------------------------------
+
+def _rel(path: str) -> str:
+    return os.path.relpath(os.path.abspath(path),
+                           REPO_ROOT).replace(os.sep, "/")
+
+
+def iter_py_files(roots: Sequence[str] = DEFAULT_ROOTS) -> list[str]:
+    """Repo-relative .py paths under ``roots`` (files or directories,
+    given relative to the repo root), sorted for stable output."""
+    out: list[str] = []
+    for root in roots:
+        full = os.path.join(REPO_ROOT, root)
+        if os.path.isfile(full):
+            if full.endswith(".py"):
+                out.append(_rel(full))
+            continue
+        if not os.path.isdir(full):
+            # a typo'd root must be LOUD: os.walk on a missing dir
+            # yields nothing, and "0 file(s), 0 finding(s)" reads as a
+            # green full lint having analyzed nothing
+            raise ValueError(
+                f"lint path {root!r} does not exist under the repo "
+                "root — refusing to report a clean run over nothing")
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(_rel(os.path.join(dirpath, fn)))
+    return sorted(set(out))
+
+
+def changed_py_files(roots: Sequence[str] = DEFAULT_ROOTS) -> set[str]:
+    """Repo-relative .py files under ``roots`` that differ from HEAD
+    (staged, unstaged, or untracked) — the ``--changed`` report scope.
+    Analysis always runs over the FULL surface (the cross-file rules
+    need the whole registration/read universe); only reporting narrows.
+    """
+    names: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        # a git failure must be LOUD, not an empty set — an empty scope
+        # would filter every finding and report a bogus clean run
+        try:
+            out = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise OSError(
+                f"--changed needs git ({' '.join(cmd)} failed: {e}); "
+                "run without --changed for the full report") from e
+        if out.returncode != 0:
+            raise OSError(
+                f"--changed needs git ({' '.join(cmd)} exited "
+                f"{out.returncode}: {out.stderr.strip()[:200]}); run "
+                "without --changed for the full report")
+        names.update(ln.strip() for ln in out.stdout.splitlines()
+                     if ln.strip())
+    # normalize roots the same way finding paths are normalized (_rel:
+    # repo-relative, forward slashes) — git emits 'experiments/x.py',
+    # so a './experiments' or absolute root must not silently empty the
+    # scope and filter every finding into a bogus clean run
+    norm = {_rel(os.path.join(REPO_ROOT, r)) for r in roots}
+    prefixes = tuple(r + "/" for r in norm)
+    return {n for n in names
+            if n.endswith(".py")
+            and (n.startswith(prefixes) or n in norm)}
+
+
+def load_files(paths: Sequence[str] | None = None
+               ) -> tuple[list[SourceFile], list[Finding]]:
+    """Parse the lint surface; returns (files, parse_error_findings)."""
+    files: list[SourceFile] = []
+    errors: list[Finding] = []
+    for rel in iter_py_files(paths or DEFAULT_ROOTS):
+        full = os.path.join(REPO_ROOT, rel)
+        with open(full, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            files.append(SourceFile.from_source(src, rel))
+        except SyntaxError as e:
+            errors.append(Finding(
+                rule="PARSE", path=rel, line=e.lineno or 0, symbol="",
+                message=f"file does not parse: {e.msg}"))
+    return files, errors
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+def _suppressed_rules(line_text: str) -> set[str]:
+    m = _SUPPRESS_RE.search(line_text)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def suppression_inventory(files: Iterable[SourceFile]
+                          ) -> dict[tuple[str, str], int]:
+    """{(path, rule): count} over every ``# graftlint: disable=`` comment
+    in the tree — COMMENTS, not findings, so a suppression that no
+    longer suppresses anything stays visible (and the drift guard makes
+    its removal just as loud as an addition)."""
+    inv: dict[tuple[str, str], int] = {}
+    for sf in files:
+        for text in sf.lines:
+            for rule in _suppressed_rules(text):
+                key = (sf.path, rule)
+                inv[key] = inv.get(key, 0) + 1
+    return inv
+
+
+def load_documented_suppressions(path: str = SUPPRESSIONS_PATH
+                                 ) -> dict[tuple[str, str], int]:
+    """Parse docs/graftlint_suppressions.txt: ``<path> <RULE> <count>``
+    per line, '#' comments skipped — THE parser, shared by the tier-1
+    drift guard."""
+    out: dict[tuple[str, str], int] = {}
+    with open(path, encoding="utf-8") as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            parts = ln.split()
+            if len(parts) != 3:
+                raise ValueError(
+                    f"bad suppression-inventory line {ln!r}: want "
+                    "'<path> <RULE> <count>'")
+            out[(parts[0], parts[1])] = int(parts[2])
+    return out
+
+
+def load_baseline(path: str = BASELINE_PATH) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+def lint_files(files: list[SourceFile], *,
+               rules: Sequence[str] | None = None,
+               baseline: list[dict] | None = None,
+               parse_errors: list[Finding] | None = None) -> LintResult:
+    """Run the (sub)set of rules over already-parsed files."""
+    from . import rules as rules_mod
+    active = rules_mod.get_rules(rules)
+    raw: list[Finding] = []
+    for rule in active:
+        raw.extend(rule.run(files))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    by_path = {sf.path: sf for sf in files}
+    live: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        sf = by_path.get(f.path)
+        text = (sf.lines[f.line - 1]
+                if sf and 0 < f.line <= len(sf.lines) else "")
+        rules_off = _suppressed_rules(text)
+        if f.rule in rules_off or "all" in rules_off:
+            suppressed.append(f)
+        else:
+            live.append(f)
+
+    baselined: list[Finding] = []
+    if baseline:
+        # each baseline entry excuses at most ONE live finding (a
+        # second identical violation is new work, not old debt)
+        budget: dict[tuple, int] = {}
+        for entry in baseline:
+            key = (entry["rule"], entry["path"], entry.get("symbol", ""),
+                   entry["message"])
+            budget[key] = budget.get(key, 0) + 1
+        still_live = []
+        for f in live:
+            k = f.fingerprint()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                baselined.append(f)
+            else:
+                still_live.append(f)
+        live = still_live
+
+    return LintResult(findings=live, suppressed=suppressed,
+                      baselined=baselined,
+                      parse_errors=list(parse_errors or []),
+                      files=len(files),
+                      rule_names=[r.name for r in active])
+
+
+def lint_paths(paths: Sequence[str] | None = None, *,
+               rules: Sequence[str] | None = None,
+               changed: bool = False,
+               use_baseline: bool = True) -> LintResult:
+    """Lint the repo surface (default: package + experiments).
+
+    ``changed=True`` narrows the REPORT to files differing from HEAD;
+    the analysis still covers the full surface so cross-file rules
+    (OBS01 registrations, CFG01 reads) see everything.
+    """
+    files, parse_errors = load_files(paths)
+    baseline = load_baseline() if use_baseline else None
+    result = lint_files(files, rules=rules, baseline=baseline,
+                        parse_errors=parse_errors)
+    if changed:
+        scope = changed_py_files(tuple(paths or DEFAULT_ROOTS))
+        result.findings = [f for f in result.findings if f.path in scope]
+        result.parse_errors = [f for f in result.parse_errors
+                               if f.path in scope]
+    return result
+
+
+def lint_source(src: str, path: str = "<fixture>.py", *,
+                rules: Sequence[str] | None = None) -> LintResult:
+    """Lint one in-memory source blob (the test-fixture entry point —
+    no baseline, no filesystem)."""
+    return lint_sources({path: src}, rules=rules)
+
+
+def lint_sources(sources: dict[str, str], *,
+                 rules: Sequence[str] | None = None) -> LintResult:
+    """Lint a dict of {path: source} in-memory files together (fixtures
+    for the cross-file rules)."""
+    files = [SourceFile.from_source(s, p) for p, s in sources.items()]
+    return lint_files(files, rules=rules, baseline=None)
