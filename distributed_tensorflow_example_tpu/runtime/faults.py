@@ -22,6 +22,19 @@ Spec grammar (``;``-separated rules, ``:``-separated fields)::
     loader.next:step=5:raise=IOError     # exactly the 5th fetch
     step.nan:step=7                      # global step 7's batch -> NaN
     step.inf:step=9:proc=0               # only on process 0
+    engine.decode_step:step=3            # 3rd shared decode dispatch
+    engine.decode_step:p=0.05            # flaky decode dispatches
+    engine.prefill:step=2                # 2nd prefill dispatch raises
+    engine.admit:step=1                  # 1st admission fails
+    pool.alloc:p=0.01                    # block allocator hiccups
+    http.read:step=2                     # 2nd request body read fails
+
+The ``engine.*``/``pool.*``/``http.*`` sites are the SERVING seams
+(round 14): they thread the same registry into the continuous-batching
+scheduler's dispatch points, where the engine's quarantine protocol
+(serving_batch.py — fail one request, re-dispatch survivors) is what
+the chaos soak in experiments/serving_chaos.py exercises. Like the
+training seams they are inert-by-default single ``is None`` checks.
 
 Fields: ``step=N`` fires on the site's Nth invocation (1-based; for the
 ``step.*`` sites the invocation index IS the global training step) and is
@@ -56,7 +69,11 @@ log = get_logger("faults")
 
 #: injection points the registry knows; inject() on anything else is a bug
 SITES = ("ckpt.write", "ckpt.commit", "ckpt.read", "loader.next",
-         "step.nan", "step.inf")
+         "step.nan", "step.inf",
+         # serving seams (round 14): the generation engine's dispatch
+         # points + the HTTP body read — see serving_batch/serving_http
+         "engine.prefill", "engine.decode_step", "engine.admit",
+         "pool.alloc", "http.read")
 
 #: exceptions a rule may raise — an allowlist so a typo'd spec fails at
 #: parse time, not as a silent never-firing rule

@@ -55,6 +55,44 @@ retirement) and scheduler-lane events (admit, decode_step, cow_copy)
 through :mod:`~.obs.trace` — ``POST /trace/start``/``stop`` turn the
 recorder on and dump Perfetto-loadable JSON.
 
+Round 14 — self-healing serving: the engine now carries the same
+dirty-failure contract the training loop got in round 8, driven by the
+same inert-by-default :mod:`~.runtime.faults` registry (new seams
+``engine.prefill`` / ``engine.decode_step`` / ``engine.admit`` /
+``pool.alloc``; ``http.read`` lives in serving_http):
+
+- **Deadlines + cancellation** — per-request ``deadline_ms`` (payload
+  knob or the engine's ``default_deadline_ms``) is enforced by the
+  scheduler between steps; expiry or an explicit
+  :meth:`GenerationEngine.cancel` retires the slot immediately and
+  releases its block-table refs, so paged HBM returns to the pool.
+  ``submit`` returns an :class:`EngineHandle` whose ``result(timeout)``
+  CANCELS the request on ``TimeoutError`` instead of abandoning a slot
+  that keeps decoding to ``max_new`` while holding blocks (the round-9
+  leak).
+- **Poison-request quarantine** — a prefill/admission failure fails
+  only the offending request; a shared decode-step failure triggers a
+  bounded re-dispatch protocol (retry once — transient faults heal;
+  on repeat failure the newest-admitted slot is evicted and failed
+  loudly while the survivors re-dispatch, their greedy bytes unchanged
+  vs an undisturbed run). Only a failure that consumed the donated
+  pool (``_pool_alive`` false) still escalates to the engine-fatal
+  fail-everything + rebuild path.
+- **Watchdog + graceful drain** — the scheduler bumps a monotonic
+  heartbeat every iteration; :meth:`GenerationEngine.health` reports
+  live/stalled/dead (``GET /healthz``), and :meth:`GenerationEngine.
+  drain` stops admitting (:class:`DrainingError` → 503 + Retry-After),
+  finishes in-flight requests under a bounded budget, flushes the
+  request log, then joins — :class:`EngineStalledError` (naming the
+  last-heartbeat age) if the thread never parks, from ``drain()`` and
+  ``close()`` both (a hung scheduler is no longer silently tolerated).
+
+Observables: ``serving_cancelled_total`` /
+``serving_deadline_expired_total`` / ``serving_redispatches_total`` /
+``serving_drain_ms`` ride the same registry as everything else;
+``experiments/serving_chaos.py`` is the seeded soak gate over all of
+it (tier-1 fast smoke in tests/test_serving_chaos.py).
+
 Round 10 — block-paged pool + shared-prefix reuse: with a PAGED
 stepwise artifact (``export_generator(..., paged=True)``) the engine
 swaps the ``slots × T`` slab reservation for a shared pool of
@@ -79,10 +117,13 @@ import threading
 import time
 from collections import OrderedDict, deque
 # the stdlib Future is the right primitive (set_result/set_exception/
-# result(timeout) — TimeoutError has been the builtin alias since 3.8);
-# the repo already leans on concurrent.futures elsewhere (async ckpt
-# writer, streaming decode pool)
+# result(timeout)); NOTE concurrent.futures.TimeoutError only became
+# the builtin TimeoutError alias in 3.11 — on 3.10 they are distinct
+# classes, so timeout handling must catch BOTH. The repo already leans
+# on concurrent.futures elsewhere (async ckpt writer, streaming decode
+# pool)
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 
 import uuid
 
@@ -90,7 +131,11 @@ import numpy as np
 
 from .obs.registry import Registry
 from .obs.trace import add_span, span
+from .runtime import faults
 from .serving import ServableModel, StepwiseGenerator
+from .utils.logging import get_logger
+
+log = get_logger("serving")
 
 
 class QueueFullError(Exception):
@@ -102,10 +147,48 @@ class QueueFullError(Exception):
         self.retry_after = retry_after
 
 
+class DrainingError(Exception):
+    """The engine is draining (graceful shutdown): no new admissions.
+    HTTP maps this to 503 + Retry-After — the client should retry
+    against another replica (or the same one after it restarts)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 class BlocksExhaustedError(Exception):
     """The paged cache pool has no free physical block left (even after
     prefix-cache eviction). The one request that needed the block fails
     loudly; the engine keeps serving its neighbors."""
+
+
+class RequestCancelledError(Exception):
+    """This request was cancelled (``POST /cancel/<request_id>``, an
+    :class:`EngineHandle` timeout, or ``handle.cancel()``) — its slot
+    and cache blocks were released the moment the scheduler saw the
+    cancellation."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's ``deadline_ms`` budget expired before it finished;
+    the scheduler retired it between steps (HTTP: 504). A
+    ``TimeoutError`` subclass so generic timeout handling still
+    applies."""
+
+
+class PoisonedRequestError(RuntimeError):
+    """This request was failed by the engine's quarantine protocol: its
+    own admission/prefill dispatch raised, or it was the newest-admitted
+    slot when a shared decode step failed twice in a row. Its neighbors
+    kept decoding (HTTP: 500 for THIS request only)."""
+
+
+class EngineStalledError(RuntimeError):
+    """The scheduler thread failed to park within the close/drain
+    budget — the hung-thread condition ``join(timeout)`` used to
+    swallow silently. Carries the last-heartbeat age so the operator
+    sees HOW wedged the thread is."""
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +397,7 @@ class BlockPool:
     def alloc(self, n: int) -> list[int]:
         """``n`` fresh blocks, refcount 1 each — all-or-nothing (a
         caller never holds a partial run)."""
+        faults.inject("pool.alloc", detail=f"n={n}")
         if n > len(self._free):
             raise BlocksExhaustedError(
                 f"need {n} cache block(s), {len(self._free)} free "
@@ -509,7 +593,12 @@ def filter_logits_np(logits: np.ndarray, top_k: int,
     return out
 
 
-@dataclasses.dataclass
+# eq=False: a request is an IDENTITY object — the deadline/cancel
+# paths remove specific instances from the queue (deque.remove), and
+# the generated field-wise __eq__ would compare numpy prompts of
+# different lengths (a broadcast ValueError that escalated to the
+# engine-fatal handler — caught by the chaos soak's deadline storm)
+@dataclasses.dataclass(eq=False)
 class GenRequest:
     """One queued ``:generate`` request (per-request sampling knobs —
     the artifact's baked values are only the defaults)."""
@@ -525,6 +614,10 @@ class GenRequest:
     # to retirement (response field, trace-span args, JSONL event);
     # the stamps become the per-request `timings` breakdown
     request_id: str = ""
+    # deadline_ms=0 means no deadline; deadline_t is the absolute
+    # perf_counter instant the scheduler enforces between steps
+    deadline_ms: int = 0
+    deadline_t: float = 0.0
     future: Future = dataclasses.field(default_factory=Future)
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
     t_admit: float = 0.0            # popped from the queue (slot owned)
@@ -538,13 +631,66 @@ class GenRequest:
         return np.random.Generator(np.random.Philox(key=self.seed))
 
 
+class EngineHandle:
+    """Client-side handle on one submitted request: the future plus the
+    cancellation lever. :meth:`result` CANCELS the request when the
+    wait times out — the round-9 behavior (abandon the future, slot
+    keeps decoding to ``max_new`` while holding cache blocks) was a
+    slot/HBM leak with no owner; now a timed-out client provably
+    returns its resources to the pool."""
+
+    __slots__ = ("_engine", "req")
+
+    def __init__(self, engine: "GenerationEngine", req: GenRequest):
+        self._engine = engine
+        self.req = req
+
+    @property
+    def request_id(self) -> str:
+        return self.req.request_id
+
+    @property
+    def timings(self) -> dict | None:
+        return self.req.timings
+
+    def done(self) -> bool:
+        return self.req.future.done()
+
+    def cancel(self) -> bool:
+        """Ask the engine to cancel this request (queued: failed
+        immediately; live: retired at the next step boundary, blocks
+        released). False when the request already retired."""
+        return self._engine.cancel(self.req.request_id)
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        """The generated tokens, or the request's failure. A wait that
+        times out cancels the request before re-raising, so the slot
+        and its cache blocks are released instead of leaking."""
+        try:
+            return self.req.future.result(timeout)
+        except (TimeoutError, _FutureTimeout):
+            # a DeadlineExceededError set BY the engine lands here too
+            # (TimeoutError subclass): cancel() then returns False —
+            # the request already retired — and the original re-raises
+            if self.cancel():
+                raise TimeoutError(
+                    f"request {self.req.request_id} still running after "
+                    f"{timeout}s — cancelled (slot and cache blocks "
+                    "released)") from None
+            raise
+
+
 class _Slot:
     """Scheduler-side state of one live cache-pool row."""
 
     def __init__(self, req: GenRequest, index: int, pad: int, pos: int,
-                 rng):
+                 rng, seq: int = 0):
         self.req = req
         self.index = index
+        # admission order, engine-wide: the re-dispatch protocol evicts
+        # the NEWEST-admitted slot on repeated decode failure (the most
+        # recent composition change is the most likely poison)
+        self.admit_seq = seq
         self.pad = pad
         self.pos = pos                  # next cache slot to be written
         self.rng = rng
@@ -574,7 +720,7 @@ class _Slot:
 
 @scheduler_owned("_pool", "_live", "_free", "_admitting", "_tables",
                  "blocks", "prefix_cache", "_slot_freed_t", "_retry",
-                 "_steps_to_free_hint")
+                 "_steps_to_free_hint", "_admit_counter")
 class GenerationEngine:
     """The continuous-batching scheduler (see module docstring).
 
@@ -592,7 +738,10 @@ class GenerationEngine:
     def __init__(self, stepwise: StepwiseGenerator, *,
                  max_queue: int = 64, prefix_cache: bool = True,
                  registry: Registry | None = None,
-                 metrics_logger=None, thread_sanitizer: bool = False):
+                 metrics_logger=None, thread_sanitizer: bool = False,
+                 default_deadline_ms: int = 0,
+                 drain_timeout_s: float = 30.0,
+                 stall_after_s: float = 10.0):
         self.sw = stepwise
         m = stepwise.step_meta
         self.slots: int = int(m["slots"])
@@ -618,6 +767,28 @@ class GenerationEngine:
         # the request currently being prefilled (popped from the queue
         # but not yet live) — the fault handler must fail it too
         self._admitting: GenRequest | None = None
+        # ---- self-healing state (round 14) --------------------------
+        if default_deadline_ms < 0:
+            raise ValueError(f"default_deadline_ms must be >= 0 "
+                             f"(0 = no deadline), got "
+                             f"{default_deadline_ms}")
+        self.default_deadline_ms = int(default_deadline_ms)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.stall_after_s = float(stall_after_s)
+        # stop admitting, finish in-flight: flipped by drain()
+        self._draining = False
+        # request ids popped from the queue and not yet retired —
+        # shared under _cond so cancel()/drain()/health() can answer
+        # without touching the scheduler-owned _live map
+        self._inflight_ids: set[str] = set()
+        # cancellations awaiting the scheduler's next step boundary
+        self._cancel_ids: set[str] = set()
+        # monotonic heartbeat the scheduler bumps every iteration (a
+        # plain float: atomic to read cross-thread, like
+        # _steps_to_free_hint) — the watchdog's signal
+        self._heartbeat: float = time.monotonic()
+        # admission sequence for the eviction order (newest first)
+        self._admit_counter = 0
         # ---- telemetry: ALL counters live in the registry (one lock,
         # atomic snapshot) — /stats, /metrics and the legacy attribute
         # reads below are views of the same values. An optional
@@ -645,6 +816,21 @@ class GenerationEngine:
             "requests failed loudly (block exhaustion, engine fault)")
         self._c_tokens_out = reg.counter(
             "serving_tokens_out_total", "tokens sampled across requests")
+        self._c_cancelled = reg.counter(
+            "serving_cancelled_total",
+            "requests cancelled (POST /cancel, handle.cancel(), or a "
+            "timed-out EngineHandle.result)")
+        self._c_deadline = reg.counter(
+            "serving_deadline_expired_total",
+            "requests retired by deadline_ms expiry (queued or live)")
+        self._c_redispatches = reg.counter(
+            "serving_redispatches_total",
+            "shared decode dispatches repeated by the re-dispatch "
+            "protocol (transient retry, or survivors after a poison "
+            "eviction)")
+        self._g_drain_ms = reg.gauge(
+            "serving_drain_ms",
+            "wall-clock milliseconds the last graceful drain took")
         self._g_queue_depth = reg.gauge(
             "serving_queue_depth", "requests waiting for admission")
         self._g_live_slots = reg.gauge(
@@ -799,6 +985,7 @@ class GenerationEngine:
                       temperature: float | None = None,
                       top_k: int | None = None, top_p: float | None = None,
                       seed: int = 0, request_id: str | None = None,
+                      deadline_ms: int | None = None,
                       eos_id: int | None = ...) -> GenRequest:
         """Validate client inputs into a :class:`GenRequest` — every
         check happens HERE, on the caller's thread, so nothing
@@ -844,6 +1031,17 @@ class GenerationEngine:
                 "top_k/top_p shape the SAMPLING distribution; greedy "
                 "decoding (temperature=0) would silently ignore them — "
                 "set temperature > 0")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if isinstance(deadline_ms, bool) \
+                or not isinstance(deadline_ms, (int, np.integer)) \
+                or deadline_ms < 0:
+            raise ValueError(
+                f"deadline_ms must be a non-negative integer "
+                f"(milliseconds; 0 = no deadline), got {deadline_ms!r}")
+        if deadline_ms:
+            req.deadline_ms = int(deadline_ms)
+            req.deadline_t = req.submitted_at + deadline_ms / 1e3
         return req
 
     def _enqueue(self, reqs: list[GenRequest]) -> list[Future]:
@@ -853,6 +1051,11 @@ class GenerationEngine:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine is stopped")
+            if self._draining:
+                raise DrainingError(
+                    "engine is draining (graceful shutdown): no new "
+                    "admissions — retry later or against another "
+                    "replica", retry_after=self._retry_after())
             if len(self._queue) + len(reqs) > self.max_queue:
                 raise QueueFullError(
                     f"admission queue full ({len(self._queue)} waiting, "
@@ -866,19 +1069,23 @@ class GenerationEngine:
             self._cond.notify_all()
         return [r.future for r in reqs]
 
-    def submit(self, prompt, **kw) -> Future:
-        """Queue one request; returns its Future. Raises ``ValueError``
-        for invalid client inputs (clear faults naming the limit) and
-        :class:`QueueFullError` when the admission queue is at
-        ``max_queue``."""
-        return self._enqueue([self._make_request(prompt, **kw)])[0]
+    def submit(self, prompt, **kw) -> EngineHandle:
+        """Queue one request; returns its :class:`EngineHandle` (a
+        future-shaped wrapper whose ``result(timeout)`` cancels on
+        timeout instead of leaking the slot). Raises ``ValueError``
+        for invalid client inputs (clear faults naming the limit),
+        :class:`QueueFullError` at ``max_queue``, and
+        :class:`DrainingError` during a graceful drain."""
+        req = self._make_request(prompt, **kw)
+        self._enqueue([req])
+        return EngineHandle(self, req)
 
-    def submit_many(self, prompts, **kw) -> list[Future]:
+    def submit_many(self, prompts, **kw) -> list[EngineHandle]:
         """Validate EVERY prompt, then queue all of them atomically —
         the multi-row request path (row i samples under ``seed + i``
         so rows stay independent)."""
-        return [r.future for r in self.submit_many_requests(prompts,
-                                                            **kw)]
+        return [EngineHandle(self, r)
+                for r in self.submit_many_requests(prompts, **kw)]
 
     def submit_many_requests(self, prompts, *,
                              request_ids: list[str] | None = None,
@@ -901,8 +1108,106 @@ class GenerationEngine:
         return reqs
 
     def generate(self, prompt, timeout: float = 300.0, **kw) -> list[int]:
-        """Blocking convenience wrapper: submit + wait."""
+        """Blocking convenience wrapper: submit + wait. A timed-out
+        wait CANCELS the request (see :meth:`EngineHandle.result`) —
+        the slot and its cache blocks come back to the pool instead of
+        decoding to ``max_new`` for a client that already gave up."""
         return self.submit(prompt, **kw).result(timeout)
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel one request by id (thread-safe — the
+        ``POST /cancel/<request_id>`` path). A QUEUED request fails
+        immediately with :class:`RequestCancelledError`; a LIVE (or
+        mid-admission) request is retired at the scheduler's next step
+        boundary, releasing its slot and block-table refs. Returns
+        False when the id is unknown or already retired."""
+        with self._cond:
+            if self._closed:
+                return False
+            victim = next((r for r in self._queue
+                           if r.request_id == request_id), None)
+            if victim is not None:
+                self._queue.remove(victim)
+                self._g_queue_depth.set(len(self._queue))
+            elif request_id in self._inflight_ids:
+                self._cancel_ids.add(request_id)
+                self._cond.notify_all()
+                return True
+            else:
+                return False
+        self._c_cancelled.inc()
+        victim.future.set_exception(RequestCancelledError(
+            f"request {request_id} cancelled while queued"))
+        return True
+
+    def health(self) -> dict:
+        """The watchdog's view (``GET /healthz``): ``live`` while the
+        scheduler thread is alive and its heartbeat is younger than
+        ``stall_after_s``; ``stalled`` when the thread exists but the
+        heartbeat aged out (a wedged dispatch); ``dead`` once the
+        thread exited (clean close/drain, or a crash); ``idle`` before
+        ``start()``. Reads only cross-thread-safe state — never the
+        scheduler-owned fields."""
+        with self._cond:
+            queued = len(self._queue)
+            inflight = len(self._inflight_ids)
+            draining = self._draining
+            closed = self._closed
+        t = self._thread
+        age = max(0.0, time.monotonic() - self._heartbeat)
+        if t is not None and t.is_alive():
+            status = "stalled" if age > self.stall_after_s else "live"
+        elif t is None and not closed:
+            status = "idle"
+        else:
+            status = "dead"
+        return {"status": status,
+                "heartbeat_age_s": round(age, 3),
+                "stall_after_s": self.stall_after_s,
+                "queue_depth": queued, "inflight": inflight,
+                "draining": draining}
+
+    def drain(self, timeout_s: float | None = None) -> float:
+        """Graceful shutdown: stop admitting (``submit`` raises
+        :class:`DrainingError` → HTTP 503 + Retry-After), let the
+        scheduler finish every queued and in-flight request under the
+        ``timeout_s`` budget (default ``drain_timeout_s``), flush the
+        request log, then stop and join the thread. Publishes and
+        returns the wall-clock drain time (``serving_drain_ms``).
+        Raises :class:`EngineStalledError` — naming the last-heartbeat
+        age — if the scheduler never parks; requests the budget
+        stranded are failed loudly by the :meth:`close` tail."""
+        timeout_s = (self.drain_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            scheduler_up = (self._thread is not None
+                            and self._thread.is_alive())
+        if scheduler_up:
+            while time.perf_counter() < deadline:
+                with self._cond:
+                    # _inflight_ids covers admitted AND mid-admission
+                    # requests, so queue-empty + inflight-empty means
+                    # fully drained (no scheduler-owned field touched)
+                    idle = (not self._queue
+                            and not self._inflight_ids)
+                if idle:
+                    break
+                time.sleep(0.005)
+        try:
+            self.close(timeout=max(1.0,
+                                   deadline - time.perf_counter()))
+        finally:
+            drain_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            self._g_drain_ms.set(drain_ms)
+            if self.metrics_logger is not None:
+                flush = getattr(self.metrics_logger, "flush", None)
+                if flush is not None:
+                    flush()
+        return drain_ms
 
     @snapshot_view
     def _retry_after(self) -> float:
@@ -928,24 +1233,38 @@ class GenerationEngine:
         self._thread.start()
         return self
 
-    def close(self) -> None:
+    def close(self, timeout: float = 10.0) -> None:
+        """Fail-fast stop: park the scheduler, then fail every request
+        still queued or live (a hung client is worse than a clear
+        error — :meth:`drain` is the graceful path that finishes them
+        instead). A scheduler thread that does NOT park within
+        ``timeout`` raises :class:`EngineStalledError` naming the
+        last-heartbeat age — the silent ``join(timeout=10)`` of rounds
+        9–13 let the sanitizer's post-join disarm lie about a thread
+        that was still running."""
         with self._cond:
             self._running = False
             self._closed = True
             self._cond.notify_all()
-        joined = True
         if self._thread is not None:
-            self._thread.join(timeout=10)
-            joined = not self._thread.is_alive()
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # still running: ownership has NOT reverted (sanitizer
+                # stays armed, in-flight futures stay unresolved — the
+                # wedged thread may yet finish them). Raise before any
+                # teardown touches scheduler-owned state.
+                age = max(0.0, time.monotonic() - self._heartbeat)
+                raise EngineStalledError(
+                    f"scheduler thread failed to park within "
+                    f"{timeout:.1f}s of close(); last heartbeat "
+                    f"{age:.1f}s ago — the engine is wedged "
+                    "mid-dispatch (in-flight requests were NOT failed; "
+                    "the thread-ownership sanitizer stays armed)")
             self._thread = None
         # the scheduler thread is joined: ownership reverts to the
         # closing thread (disarm the sanitizer, THR01 suppressed below
         # for the same reason — these accesses are post-join teardown).
-        # A TIMED-OUT join keeps the sanitizer armed: the scheduler is
-        # still running, so the teardown below racing it is exactly the
-        # violation class the sanitizer exists to raise on.
-        if joined:
-            self._san_tid = None
+        self._san_tid = None
         # fail whatever never got scheduled — a hung client is worse
         # than a clear error
         err = RuntimeError("generation engine stopped")
@@ -960,30 +1279,45 @@ class GenerationEngine:
                 slot.req.future.set_exception(err)
             self._live.clear()  # graftlint: disable=THR01
             self._g_live_slots.set(0)
+            self._inflight_ids.clear()
+            self._cancel_ids.clear()
 
     @scheduler_thread
     def _loop(self) -> None:
         self._san_tid = threading.get_ident()
+        self._heartbeat = time.monotonic()
         while True:
+            self._heartbeat = time.monotonic()
             with self._cond:
                 while (self._running and not self._queue
-                       and not self._live):
+                       and not self._live and not self._cancel_ids):
                     self._cond.wait(timeout=0.5)
+                    # idle bump: the watchdog must see a parked-but-
+                    # healthy scheduler as live, not stalled
+                    self._heartbeat = time.monotonic()
                 if not self._running:
                     return
             try:
+                self._apply_cancellations()
+                self._expire_deadlines()
                 self._admit()
                 if self._live:
                     self._shared_step()
-            except Exception as e:                      # pragma: no cover
-                # an executable fault poisons every in-flight request
-                # (client input cannot raise here — it is fully
-                # validated on the submitter's thread): surface it to
-                # all waiters INCLUDING a request that died mid-admit,
-                # then rebuild the pool — its buffers were donated to
-                # the failed call, so reusing the old reference would
-                # wedge every later dispatch on a deleted array
+            except Exception as e:
+                # a fault that consumed the donated pool poisons every
+                # in-flight request (anything recoverable was already
+                # quarantined to its one request by _admit/
+                # _dispatch_decode; client input cannot raise here —
+                # it is fully validated on the submitter's thread):
+                # surface it to all waiters INCLUDING a request that
+                # died mid-admit, then rebuild the pool — its buffers
+                # were donated to the failed call, so reusing the old
+                # reference would wedge every later dispatch on a
+                # deleted array
                 err = RuntimeError(f"scheduler step failed: {e}")
+                log.warning("engine-fatal scheduler fault (%d live "
+                            "request(s) failed, pool rebuilt): %s",
+                            len(self._live), e)
                 with self._cond:
                     if self._admitting is not None:
                         self._admitting.future.set_exception(err)
@@ -995,6 +1329,8 @@ class GenerationEngine:
                     self._live.clear()
                     self._g_live_slots.set(0)
                     self._free = list(range(self.slots))[::-1]
+                    self._inflight_ids.clear()
+                    self._cancel_ids.clear()
                 self._pool = self.sw.make_pool()
                 if self.paged:
                     # the rebuilt pool is empty: every table entry and
@@ -1010,6 +1346,78 @@ class GenerationEngine:
                             registry=self.registry)
 
     @scheduler_thread
+    def _apply_cancellations(self) -> None:
+        """Honor pending :meth:`cancel` calls at the step boundary:
+        every live slot whose request id was cancelled retires NOW,
+        releasing its slot and block-table refs (queued cancellations
+        were already failed on the canceller's thread)."""
+        with self._cond:
+            if not self._cancel_ids:
+                return
+            ids = set(self._cancel_ids)
+        for slot in list(self._live.values()):
+            rid = slot.req.request_id
+            if rid in ids:
+                self._fail_slot(slot, RequestCancelledError(
+                    f"request {rid} cancelled after "
+                    f"{len(slot.tokens)} token(s)"),
+                    counter=self._c_cancelled)
+        # a cancel that landed while its request was MID-ADMISSION can
+        # find the request back in the queue: block-pressure deferral
+        # re-queues at the head (dropping the in-flight id), and the
+        # queued-cancel fast path in cancel() already ran — without
+        # this sweep the accepted cancellation would be silently lost
+        # and the request later admitted, the exact leak cancel()
+        # promised to prevent
+        requeued: list[GenRequest] = []
+        with self._cond:
+            for r in list(self._queue):
+                if r.request_id in ids:
+                    self._queue.remove(r)
+                    requeued.append(r)
+            if requeued:
+                self._g_queue_depth.set(len(self._queue))
+        for r in requeued:
+            self._c_cancelled.inc()
+            r.future.set_exception(RequestCancelledError(
+                f"request {r.request_id} cancelled while re-queued "
+                "under block pressure"))
+        with self._cond:
+            # keep only ids still mid-admission (they land in _live
+            # next boundary and retire then); everything else — just
+            # handled, or already retired — is done
+            self._cancel_ids &= self._inflight_ids
+
+    @scheduler_thread
+    def _expire_deadlines(self) -> None:
+        """Enforce per-request ``deadline_ms`` between steps: expired
+        QUEUED requests fail without ever taking a slot, expired LIVE
+        slots retire immediately (blocks released) — a deadline is a
+        promise about resources, not just latency."""
+        now = time.perf_counter()
+        expired: list[GenRequest] = []
+        with self._cond:
+            for r in list(self._queue):
+                if r.deadline_t and now >= r.deadline_t:
+                    self._queue.remove(r)
+                    expired.append(r)
+            if expired:
+                self._g_queue_depth.set(len(self._queue))
+        for r in expired:
+            self._c_deadline.inc()
+            r.future.set_exception(DeadlineExceededError(
+                f"request {r.request_id} missed its {r.deadline_ms} ms "
+                "deadline while queued (never admitted)"))
+        for slot in list(self._live.values()):
+            req = slot.req
+            if req.deadline_t and now >= req.deadline_t:
+                self._fail_slot(slot, DeadlineExceededError(
+                    f"request {req.request_id} missed its "
+                    f"{req.deadline_ms} ms deadline after "
+                    f"{len(slot.tokens)} token(s)"),
+                    counter=self._c_deadline)
+
+    @scheduler_thread
     def _admit(self) -> None:
         """Drain the queue into free slots. Runs between shared steps —
         admission joins mid-flight. Slab path: one prefill dispatch per
@@ -1017,7 +1425,12 @@ class GenerationEngine:
         and teacher-force the uncached suffix through the SHARED step
         (zero prefill dispatches); misses allocate a block run and run
         the paged prefill. Block pressure pushes the request back to
-        the queue head — retirement (or cache eviction) clears it."""
+        the queue head — retirement (or cache eviction) clears it.
+
+        Quarantine (round 14): an admission/prefill failure that left
+        the donated pool intact fails ONLY the offending request
+        (:meth:`_fail_admission`); only a pool-consuming fault
+        escalates to the loop's engine-fatal handler."""
         while True:
             with self._cond:
                 if not self._queue or not self._free:
@@ -1026,6 +1439,7 @@ class GenerationEngine:
                 index = self._free.pop()
                 self._g_queue_depth.set(len(self._queue))
                 self._admitting = req
+                self._inflight_ids.add(req.request_id)
             req.t_admit = time.perf_counter()
             # the slot lane shows the tail of the wait spent waiting
             # for THIS slot (lanes must tile under reuse); the full
@@ -1036,16 +1450,63 @@ class GenerationEngine:
                      request_id=req.request_id,
                      queued_ms=round((req.t_admit - req.submitted_at)
                                      * 1e3, 3))
-            if self.paged:
-                admitted = self._admit_paged(req, index)
-            else:
-                self._admit_slab(req, index)
-                admitted = True
+            try:
+                faults.inject("engine.admit", detail=req.request_id)
+                if self.paged:
+                    admitted = self._admit_paged(req, index)
+                else:
+                    self._admit_slab(req, index)
+                    admitted = True
+            except Exception as e:
+                if not self._pool_alive():
+                    raise          # donated pool consumed: engine-fatal
+                self._fail_admission(req, index, e)
+                admitted = True                     # slot already freed
             with self._cond:
                 self._admitting = None
                 self._g_live_slots.set(len(self._live))
                 if not admitted:
                     return
+
+    @scheduler_thread
+    def _pool_alive(self) -> bool:
+        """True while the engine's pool buffers are still usable. Both
+        stepwise programs DONATE the pool; a dispatch that failed
+        before consuming it (a seam injection, host-side validation)
+        leaves every buffer intact — the quarantine protocol's
+        recoverable case — while a failure that deleted them forces
+        the engine-fatal rebuild."""
+        for v in self._pool.values():
+            deleted = getattr(v, "is_deleted", None)
+            if deleted is not None and deleted():
+                return False
+        return True
+
+    @scheduler_thread
+    def _fail_admission(self, req: GenRequest, index: int,
+                        err: Exception) -> None:
+        """Quarantine one failed admission: the offending request fails
+        loudly, its slot returns to the free list, and every neighbor
+        keeps decoding — one bad request must never be engine-fatal."""
+        log.warning("admission of request %s failed (quarantined): %s",
+                    req.request_id, err)
+        with self.registry.atomic():
+            self._c_admissions.inc()
+            self._c_requests_failed.inc()
+            if self.paged and self.prefix_cache is not None:
+                # an admission outcome counts hit or miss exactly once;
+                # a failed admission never mounted cached blocks
+                self.prefix_cache.record_miss()
+        with self._cond:
+            self._free.append(index)
+            self._inflight_ids.discard(req.request_id)
+        self._slot_freed_t[index] = time.perf_counter()
+        req.future.set_exception(
+            err if isinstance(err, BlocksExhaustedError)
+            else PoisonedRequestError(
+                f"request {req.request_id} failed at admission "
+                f"({type(err).__name__}: {err}); its neighbors were "
+                "not disturbed"))
 
     @scheduler_thread
     def _admit_slab(self, req: GenRequest, index: int) -> None:
@@ -1056,18 +1517,28 @@ class GenerationEngine:
         mask[0, :p] = 1
         with span("prefill", lane=f"slot{index}",
                   request_id=req.request_id, prompt_tokens=p):
+            faults.inject("engine.prefill", detail=req.request_id)
             out = self.sw.prefill({
                 "input_ids": ids, "prompt_mask": mask,
                 "slot": np.int32(index), **self._pool})
+            # materialize BEFORE adopting the returned pool: on an
+            # async backend a device-side fault surfaces at this block,
+            # and self._pool must still name the donated (now deleted)
+            # inputs so _pool_alive() escalates to the engine-fatal
+            # rebuild instead of quarantining over a poisoned pool
+            logits0 = np.asarray(out["logits"])[0]
+            pad0 = int(np.asarray(out["pad"])[0])
             self._pool = {k: v for k, v in out.items()
                           if k.startswith("cache_")}
         with self.registry.atomic():
             self._c_admissions.inc()
             self._c_prefills.inc()
-        slot = _Slot(req, index, pad=int(np.asarray(out["pad"])[0]),
-                     pos=self.prompt_len, rng=req.sampler())
+        self._admit_counter += 1
+        slot = _Slot(req, index, pad=pad0,
+                     pos=self.prompt_len, rng=req.sampler(),
+                     seq=self._admit_counter)
         slot.t_prefill_done = time.perf_counter()
-        tok = self._pick(slot, np.asarray(out["logits"])[0])
+        tok = self._pick(slot, logits0)
         self._emit(slot, tok)
 
     @scheduler_thread
@@ -1099,7 +1570,9 @@ class GenerationEngine:
                 self._c_admissions.inc()
                 self.prefix_cache.record_hit()
                 self._c_tokens_saved.inc(start)
-            slot = _Slot(req, index, pad=0, pos=start, rng=req.sampler())
+            self._admit_counter += 1
+            slot = _Slot(req, index, pad=0, pos=start,
+                         rng=req.sampler(), seq=self._admit_counter)
             slot.t_prefill_done = time.perf_counter()
             slot.last_tok = int(tokens[start])
             slot.forced = [int(t) for t in tokens[start + 1:]]
@@ -1126,19 +1599,12 @@ class GenerationEngine:
                     self._queue.appendleft(req)
                     self._g_queue_depth.set(len(self._queue))
                     self._free.append(index)
+                    self._inflight_ids.discard(req.request_id)
                 self._slot_freed_t[index] = time.perf_counter()
                 return False
             # nothing live, cache already evicted: the pool simply
             # cannot hold this prompt — fail IT, keep serving
-            with self.registry.atomic():
-                self._c_admissions.inc()
-                self._c_requests_failed.inc()
-                if self.prefix_cache is not None:
-                    self.prefix_cache.record_miss()
-            with self._cond:
-                self._free.append(index)
-            self._slot_freed_t[index] = time.perf_counter()
-            req.future.set_exception(BlocksExhaustedError(
+            self._fail_admission(req, index, BlocksExhaustedError(
                 f"prompt of {p} tokens needs {needed} cache blocks but "
                 f"the pool cannot free them: {e}"))
             return True
@@ -1148,13 +1614,27 @@ class GenerationEngine:
         mask = np.zeros((1, self.prompt_len), np.int32)
         ids[0, :p] = tokens
         mask[0, :p] = 1
-        with span("prefill", lane=f"slot{index}",
-                  request_id=req.request_id, prompt_tokens=p):
-            out = self.sw.prefill({
-                "input_ids": ids, "prompt_mask": mask,
-                "table_row": table_row, **self._pool})
-            self._pool = {k: v for k, v in out.items()
-                          if k.startswith("cache_")}
+        try:
+            with span("prefill", lane=f"slot{index}",
+                      request_id=req.request_id, prompt_tokens=p):
+                faults.inject("engine.prefill", detail=req.request_id)
+                out = self.sw.prefill({
+                    "input_ids": ids, "prompt_mask": mask,
+                    "table_row": table_row, **self._pool})
+                # materialize BEFORE adopting the returned pool (see
+                # _admit_slab): an async device fault must leave
+                # self._pool naming the donated inputs so the outer
+                # handler's _pool_alive() probe escalates correctly
+                logits0 = np.asarray(out["logits"])[0]
+                self._pool = {k: v for k, v in out.items()
+                              if k.startswith("cache_")}
+        except Exception:
+            # quarantine path (the outer _admit handler fails the
+            # request): the block run allocated above must go back to
+            # the pool first — a failed admission must not leak HBM.
+            # A pool-consuming fault still escalates there.
+            self.blocks.release(run)
+            raise
         with self.registry.atomic():
             self._c_admissions.inc()
             self._c_prefills.inc()
@@ -1163,9 +1643,11 @@ class GenerationEngine:
         self._tables[index, :needed] = run
         if self.prefix_cache is not None:
             self.prefix_cache.insert(tokens, run)
-        slot = _Slot(req, index, pad=0, pos=p, rng=req.sampler())
+        self._admit_counter += 1
+        slot = _Slot(req, index, pad=0, pos=p, rng=req.sampler(),
+                     seq=self._admit_counter)
         slot.t_prefill_done = time.perf_counter()
-        tok = self._pick(slot, np.asarray(out["logits"])[0])
+        tok = self._pick(slot, logits0)
         self._emit(slot, tok)
         return True
 
@@ -1182,15 +1664,22 @@ class GenerationEngine:
         row[:] = 0
 
     @scheduler_thread
-    def _fail_slot(self, slot: _Slot, err: Exception) -> None:
-        """Fail ONE live request loudly (mid-decode block exhaustion)
-        without disturbing its neighbors."""
-        self._release_slot_blocks(slot.index)
+    def _fail_slot(self, slot: _Slot, err: Exception,
+                   counter=None) -> None:
+        """Retire ONE live request with ``err`` — block exhaustion,
+        quarantine eviction, cancellation, or deadline expiry — without
+        disturbing its neighbors: table refs released (paged), slot
+        freed, THEN the future resolves. ``counter`` picks which
+        retirement counter advances (default: requests_failed)."""
+        if self.paged:
+            self._release_slot_blocks(slot.index)
         del self._live[slot.index]
-        self._c_requests_failed.inc()
+        (counter if counter is not None
+         else self._c_requests_failed).inc()
         with self._cond:
             self._free.append(slot.index)
             self._g_live_slots.set(len(self._live))
+            self._inflight_ids.discard(slot.req.request_id)
         self._slot_freed_t[slot.index] = time.perf_counter()
         slot.req.future.set_exception(err)
 
@@ -1292,6 +1781,7 @@ class GenerationEngine:
             with self._cond:
                 self._free.append(slot.index)
                 self._g_live_slots.set(len(self._live))
+                self._inflight_ids.discard(req.request_id)
         self._slot_freed_t[slot.index] = time.perf_counter()
         # counters BEFORE the future resolves: a client waking on
         # result() must find requests_done already advanced (tests and
@@ -1312,6 +1802,91 @@ class GenerationEngine:
             self.metrics_logger.log({"event": "generate", **req.timings})
 
     @scheduler_thread
+    def _build_step_feats(self) -> dict:
+        """The shared decode step's operand dict for the CURRENT live
+        set — rebuilt after a quarantine eviction so survivors
+        re-dispatch with the dead row marked not-alive."""
+        tok = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        pad = np.zeros((self.slots,), np.int32)
+        alive = np.zeros((self.slots,), np.int32)
+        for i, s in self._live.items():
+            tok[i] = s.last_tok
+            pos[i] = s.pos
+            pad[i] = s.pad
+            alive[i] = 1
+        feats = {"tok": tok, "pos": pos, "pad": pad, "alive": alive,
+                 **self._pool}
+        if self.paged:
+            feats["block_tables"] = self._tables
+        return feats
+
+    @scheduler_thread
+    def _dispatch_decode(self, feats: dict) -> np.ndarray | None:
+        """One shared decode dispatch under the bounded re-dispatch
+        protocol: a first failure that left the donated pool intact is
+        retried once (transient faults heal invisibly — same greedy
+        bytes, one extra dispatch); a REPEAT failure evicts the
+        newest-admitted slot (fails it loudly) and re-dispatches the
+        survivors, whose rows are computationally independent — their
+        greedy bytes match an undisturbed run. Bounded: at most one
+        retry plus one eviction per remaining live slot. Returns the
+        logits, or None when eviction emptied the batch. A
+        pool-consuming failure re-raises into the engine-fatal
+        handler."""
+        reg = faults.active()
+        idx = reg.next_index("engine.decode_step") \
+            if reg is not None else None
+        attempt = 0
+        while True:
+            try:
+                if reg is not None:
+                    # retries re-probe the SAME invocation index with a
+                    # bumped attempt (the loader.next convention): step=N
+                    # rules stay one-shot transients, p-rules resample
+                    reg.raise_if_armed("engine.decode_step", index=idx,
+                                       attempt=attempt)
+                with span("decode_step", lane="scheduler",
+                          slots=int(feats["alive"].sum())):
+                    out = self.sw.decode(feats)
+                    # blocks on the result BEFORE adopting the returned
+                    # pool: an async device fault surfaces here, and
+                    # self._pool must still name the donated (deleted)
+                    # inputs so _pool_alive() below escalates to the
+                    # engine-fatal rebuild — adopting first would judge
+                    # the FAILED call's outputs alive and re-dispatch
+                    # feats whose buffers were consumed
+                    logits = np.asarray(out["logits"])
+                    self._pool = {k: v for k, v in out.items()
+                                  if k.startswith("cache_")}
+                    return logits
+            except Exception as e:
+                if not self._pool_alive():
+                    raise          # donated pool consumed: engine-fatal
+                attempt += 1
+                if attempt == 1:
+                    log.warning("shared decode step failed (%s) — "
+                                "re-dispatching once", e)
+                    self._c_redispatches.inc()
+                    continue
+                victim = max(self._live.values(),
+                             key=lambda s: s.admit_seq)
+                log.warning("shared decode step failed twice — "
+                            "evicting newest-admitted request %s and "
+                            "re-dispatching %d survivor(s): %s",
+                            victim.req.request_id,
+                            len(self._live) - 1, e)
+                self._fail_slot(victim, PoisonedRequestError(
+                    f"request {victim.req.request_id} evicted after "
+                    f"repeated shared-decode failure "
+                    f"({type(e).__name__}: {e}); surviving requests "
+                    "re-dispatched undisturbed"))
+                if not self._live:
+                    return None
+                feats = self._build_step_feats()
+                self._c_redispatches.inc()
+
+    @scheduler_thread
     def _shared_step(self) -> None:
         """ONE batched decode step for every live slot."""
         if self.paged:
@@ -1326,28 +1901,23 @@ class GenerationEngine:
                     self._fail_slot(s, BlocksExhaustedError(
                         f"out of cache blocks mid-decode after "
                         f"{len(s.tokens)} tokens: {e}"))
+                except Exception as e:
+                    # e.g. an injected pool.alloc fault: quarantine the
+                    # one row whose write target failed (the pool-
+                    # consuming case — a failed COW copy — escalates)
+                    if not self._pool_alive():
+                        raise
+                    self._fail_slot(s, PoisonedRequestError(
+                        f"request {s.req.request_id}: cache write-"
+                        f"block allocation failed "
+                        f"({type(e).__name__}: {e})"))
             if not self._live:
                 return
-        tok = np.zeros((self.slots,), np.int32)
-        pos = np.zeros((self.slots,), np.int32)
-        pad = np.zeros((self.slots,), np.int32)
-        alive = np.zeros((self.slots,), np.int32)
-        for i, s in self._live.items():
-            tok[i] = s.last_tok
-            pos[i] = s.pos
-            pad[i] = s.pad
-            alive[i] = 1
-        feats = {"tok": tok, "pos": pos, "pad": pad, "alive": alive,
-                 **self._pool}
-        if self.paged:
-            feats["block_tables"] = self._tables
+        feats = self._build_step_feats()
         t0 = time.perf_counter()
-        with span("decode_step", lane="scheduler",
-                  slots=int(alive.sum())):
-            out = self.sw.decode(feats)
-            self._pool = {k: v for k, v in out.items()
-                          if k.startswith("cache_")}
-            logits = np.asarray(out["logits"])   # blocks on the result
+        logits = self._dispatch_decode(feats)
+        if logits is None:
+            return
         self._retry.observe(time.perf_counter() - t0)
         with self.registry.atomic():
             self._c_decode_steps.inc()
@@ -1429,6 +1999,10 @@ class GenerationEngine:
             "steps_shared": round(shared, 3),
             "requests_done": c("serving_requests_done_total"),
             "requests_failed": c("serving_requests_failed_total"),
+            "cancelled": c("serving_cancelled_total"),
+            "deadline_expired": c("serving_deadline_expired_total"),
+            "redispatches": c("serving_redispatches_total"),
+            "drain_ms": c("serving_drain_ms"),
             "tokens_out": c("serving_tokens_out_total"),
             "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
@@ -1514,6 +2088,11 @@ class MicroBatcher:
             "predict_request_latency_seconds",
             "submit-to-scatter request latency")
         self._latencies: deque[float] = deque(maxlen=2048)
+        # queue-full Retry-After from MEASURED micro-batch wall time
+        # (the same estimator semantics the :generate path uses) — a
+        # 429 should tell the client when capacity actually frees, not
+        # a hard-coded guess
+        self._retry = RetryAfterEstimator()
 
     @property
     def batches(self) -> int:
@@ -1538,12 +2117,19 @@ class MicroBatcher:
         self._thread.start()
         return self
 
-    def close(self) -> None:
+    def close(self, timeout: float = 10.0) -> None:
         with self._cond:
             self._running = False
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # same contract as GenerationEngine.close: a batcher
+                # thread that never parks is loud, not silently leaked
+                raise EngineStalledError(
+                    f"predict-batcher thread failed to park within "
+                    f"{timeout:.1f}s of close() — wedged mid-dispatch "
+                    "(queued requests were NOT failed)")
             self._thread = None
         err = RuntimeError("predict batcher stopped")
         with self._cond:
@@ -1558,9 +2144,14 @@ class MicroBatcher:
             if not self._running:
                 raise RuntimeError("batcher is not running")
             if len(self._queue) >= self.max_queue:
+                # steps_to_free=1: the next batch dispatch frees queue
+                # room; the queue ahead scales it into admission waves
                 raise QueueFullError(
                     f"predict queue full ({self.max_queue} requests "
-                    "waiting)", retry_after=1.0)
+                    "waiting)",
+                    retry_after=round(self._retry.estimate(
+                        1.0, queue_ahead=len(self._queue),
+                        slots=self.batch_max_size), 2))
             self._queue.append((feats, n, fut, time.perf_counter()))
             self._cond.notify_all()
         return fut
@@ -1628,9 +2219,11 @@ class MicroBatcher:
             cols = {k: np.concatenate(
                 [v, np.repeat(v[:1], bucket - n_total, axis=0)])
                 for k, v in cols.items()}
+        t0 = time.perf_counter()
         with span("predict_batch", lane="batcher", rows=n_total,
                   bucket=bucket):
             preds = np.asarray(self.servable(cols))
+        self._retry.observe(time.perf_counter() - t0)
         with self.registry.atomic():
             self._c_batches.inc()
             self._c_rows.inc(n_total)

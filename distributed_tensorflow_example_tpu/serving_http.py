@@ -76,6 +76,28 @@ Telemetry (round 11): the server owns ONE
   retired request through :class:`~.utils.metrics.MetricsLogger`;
 - ``--metrics off`` disables the registry (every increment becomes a
   single branch) for overhead-sensitive parity work.
+
+Self-healing (round 14): the server fronts the engine's failure
+contract —
+
+- ``deadline_ms`` in the ``:generate`` payload (or
+  ``--default_deadline_ms``) bounds each request; expiry answers 504
+  naming the budget, and the slot + cache blocks are already back in
+  the pool when the response leaves;
+- ``POST /cancel/<request_id>`` cancels a queued or live request
+  (200/404); the cancelled request's own waiter gets 409;
+- ``GET /healthz`` reports the scheduler watchdog (``live`` /
+  ``stalled`` / ``dead`` with the heartbeat age) — 200 only when live,
+  so a wedged scheduler thread fails load-balancer probes instead of
+  silently blackholing traffic;
+- SIGTERM (and ``stop()``) triggers a graceful drain: new admissions
+  answer 503 + Retry-After while queued/in-flight requests finish
+  under ``--drain_timeout_s``, the request log flushes, and a
+  scheduler that never parks raises ``EngineStalledError`` naming the
+  last-heartbeat age;
+- the ``http.read`` fault seam (``--fault_spec``-driven, inert by
+  default) covers the request-read path for the serving chaos soak
+  (``experiments/serving_chaos.py``).
 """
 
 from __future__ import annotations
@@ -90,9 +112,11 @@ import numpy as np
 from .obs import prom as obs_prom
 from .obs import trace as obs_trace
 from .obs.registry import Registry
+from .runtime import faults
 from .serving import ServableModel, has_stepwise, load_servable
-from .serving_batch import (GenerationEngine, MicroBatcher,
-                            QueueFullError)
+from .serving_batch import (DeadlineExceededError, DrainingError,
+                            GenerationEngine, MicroBatcher,
+                            QueueFullError, RequestCancelledError)
 
 
 class _ServerFault(Exception):
@@ -120,7 +144,10 @@ class PredictServer:
                  prefix_cache: bool = True, metrics: bool = True,
                  trace_buffer_events: int = 65536,
                  request_log: str | None = None,
-                 thread_sanitizer: bool = False):
+                 thread_sanitizer: bool = False,
+                 default_deadline_ms: int = 0,
+                 drain_timeout_s: float = 30.0,
+                 stall_after_s: float = 10.0):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
@@ -194,7 +221,10 @@ class PredictServer:
                     load_stepwise(export_dir), max_queue=max_queue,
                     prefix_cache=prefix_cache, registry=self.registry,
                     metrics_logger=self._request_logger,
-                    thread_sanitizer=thread_sanitizer).start()
+                    thread_sanitizer=thread_sanitizer,
+                    default_deadline_ms=default_deadline_ms,
+                    drain_timeout_s=drain_timeout_s,
+                    stall_after_s=stall_after_s).start()
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
@@ -422,7 +452,11 @@ class PredictServer:
         kw = {"max_new": knob("max_new", int),
               "temperature": knob("temperature", float),
               "top_k": knob("top_k", int),
-              "top_p": knob("top_p", float)}
+              "top_p": knob("top_p", float),
+              # per-request latency budget (ms; engine default applies
+              # when absent) — expiry retires the slot between steps
+              # and answers 504
+              "deadline_ms": knob("deadline_ms", int)}
         seed = payload.get("seed", 0)
         if isinstance(seed, bool) or not isinstance(seed, int):
             raise ValueError(f"'seed' must be an integer, got {seed!r}")
@@ -447,15 +481,35 @@ class PredictServer:
         # submit_many validates EVERY row before queueing ANY, and the
         # enqueue is atomic — a 400/429 on row k must not leave rows
         # 0..k-1 generating for a client that already got an error
-        reqs = self.engine.submit_many_requests(prompts, seed=seed,
-                                                request_ids=rids, **kw)
+        # submit_many returns EngineHandles: result() cancels on
+        # wall-timeout — a handler thread giving up must return the
+        # slot + cache blocks to the pool, not abandon a request
+        # decoding to max_new (the round-9 leak)
+        handles = self.engine.submit_many(prompts, seed=seed,
+                                          request_ids=rids, **kw)
+
+        def wait_all() -> list:
+            try:
+                return [h.result(timeout=300) for h in handles]
+            except BaseException:
+                # one row's failure is the WHOLE response's failure
+                # (the client gets a single error): sibling rows must
+                # not keep decoding for nobody — cancel every handle
+                # still running before surfacing the error
+                for h in handles:
+                    if not h.done():
+                        h.cancel()
+                raise
+
         try:
-            gens = [r.future.result(timeout=300) for r in reqs]
+            gens = wait_all()
+        except (DeadlineExceededError, RequestCancelledError):
+            raise          # the handler maps these to 504 / 409
         except (TimeoutError, RuntimeError) as e:
             raise _ServerFault(f"{type(e).__name__}: {e}") from e
         return {"generations": gens,
-                "request_ids": [r.request_id for r in reqs],
-                "timings": [r.timings for r in reqs]}
+                "request_ids": [h.request_id for h in handles],
+                "timings": [h.timings for h in handles]}
 
     def generate(self, payload: dict,
                  request_id: str | None = None) -> dict:
@@ -576,6 +630,13 @@ class PredictServer:
                                    f"/v1/models/{server.name}/metrics"):
                     self._send_text(200, server.metrics_text(),
                                     obs_prom.CONTENT_TYPE)
+                elif self.path in ("/healthz",
+                                   f"/v1/models/{server.name}/healthz"):
+                    # 200 ONLY while live: a wedged or dead scheduler
+                    # thread must fail load-balancer probes instead of
+                    # blackholing traffic behind a listening socket
+                    h = server.health()
+                    self._send(200 if h["status"] == "live" else 503, h)
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -585,6 +646,16 @@ class PredictServer:
                     return
                 if self.path == "/trace/stop":
                     self._send(200, server.trace_stop())
+                    return
+                if self.path.startswith("/cancel/"):
+                    rid = self.path[len("/cancel/"):]
+                    if server.cancel(rid):
+                        self._send(200, {"cancelled": rid})
+                    else:
+                        self._send(404, {
+                            "error": f"no queued or live request "
+                                     f"{rid!r} (already retired, or "
+                                     "never submitted)"})
                     return
                 routes = {f"/v1/models/{server.name}:predict":
                           server.predict,
@@ -599,6 +670,9 @@ class PredictServer:
                     if n > 1 << 30:
                         self._send(413, {"error": "request too large"})
                         return
+                    # chaos seam: a dropped/garbled request body (inert
+                    # single None-check without a registry installed)
+                    faults.inject("http.read", detail=self.path)
                     body = self.rfile.read(n)
                     if len(body) != n:
                         self._send(400, {"error": "truncated body"})
@@ -617,6 +691,19 @@ class PredictServer:
                     self._send(429, {"error": str(e)},
                                headers={"Retry-After":
                                         str(int(e.retry_after + 0.5))})
+                except DrainingError as e:
+                    # graceful shutdown in progress: in-flight requests
+                    # are finishing, new ones belong on another replica
+                    self._send(503, {"error": str(e)},
+                               headers={"Retry-After":
+                                        str(int(e.retry_after + 0.5))})
+                except DeadlineExceededError as e:
+                    # the request's own deadline_ms budget expired; its
+                    # slot and cache blocks are already back in the pool
+                    self._send(504, {"error": str(e)})
+                except RequestCancelledError as e:
+                    # cancelled out from under its waiter (POST /cancel)
+                    self._send(409, {"error": str(e)})
                 except _ServerFault as e:               # executable died:
                     # platform mismatch, runtime OOM, ... must be a 500,
                     # not a dropped connection or a client-blaming 400
@@ -673,6 +760,23 @@ class PredictServer:
         rec.stop()
         return rec.to_chrome()
 
+    def health(self) -> dict:
+        """``GET /healthz``: the engine's watchdog view (live / stalled
+        / dead with the heartbeat age). Without a scheduler thread to
+        watch (scheduler off, or a predict artifact) the server
+        answering at all IS the liveness signal."""
+        if self.engine is not None:
+            return self.engine.health()
+        return {"status": "live", "scheduler": self.scheduler}
+
+    def cancel(self, request_id: str) -> bool:
+        """``POST /cancel/<request_id>``: cancel a queued or live
+        :generate request. False (→ 404) when the id is unknown,
+        already retired, or there is no engine to cancel against."""
+        if self.engine is None:
+            return False
+        return self.engine.cancel(request_id)
+
     def stats(self) -> dict:
         """The /stats payload: scheduler mode plus per-scheduler
         counters (the generate block's ``decode_steps`` /
@@ -689,17 +793,33 @@ class PredictServer:
             out["predict"] = self.batcher.stats(snap)
         return out
 
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        if self.engine is not None:
-            self.engine.close()
-        if self.batcher is not None:
-            self.batcher.close()
-        if self._request_logger is not None:
-            self._request_logger.close()
+    def stop(self, drain: bool = True) -> None:
+        """Shut down. ``drain=True`` (default, and the SIGTERM path) is
+        graceful: the engine stops admitting (new ``:generate`` answer
+        503 + Retry-After — the HTTP listener stays up to say so),
+        queued/in-flight requests finish under ``drain_timeout_s``, the
+        request log flushes, THEN the listener closes. ``drain=False``
+        is fail-fast: listener down first, queued/live requests failed
+        loudly. Both raise :class:`~.serving_batch.EngineStalledError`
+        when the scheduler thread never parks."""
+        try:
+            if self.engine is not None and drain:
+                self.engine.drain()
+        finally:
+            # the listener comes down even when drain() raises
+            # EngineStalledError — otherwise a wedged scheduler would
+            # leave the socket up refusing everything and SIGTERM
+            # would never actually stop the process
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+            if self.engine is not None and not drain:
+                self.engine.close()
+            if self.batcher is not None:
+                self.batcher.close()
+            if self._request_logger is not None:
+                self._request_logger.close()
 
     def __enter__(self) -> "PredictServer":
         return self.start()
@@ -750,7 +870,31 @@ def main(argv=None) -> int:
                     "access (a foreign-thread touch raises "
                     "ThreadOwnershipError naming the field and thread; "
                     "off = the engine class is untouched)")
+    ap.add_argument("--default_deadline_ms", type=int, default=0,
+                    help="latency budget applied to :generate requests "
+                    "that carry no deadline_ms of their own (0 = none); "
+                    "expiry retires the slot between steps, frees its "
+                    "cache blocks, and answers 504")
+    ap.add_argument("--drain_timeout_s", type=float, default=30.0,
+                    help="graceful-drain budget on SIGTERM/stop(): new "
+                    "admissions 503 while queued/in-flight requests "
+                    "finish; a scheduler thread still running past the "
+                    "budget raises EngineStalledError")
+    ap.add_argument("--stall_after_s", type=float, default=10.0,
+                    help="GET /healthz reports 'stalled' (503) once the "
+                    "scheduler heartbeat is older than this")
+    ap.add_argument("--fault_spec", default=None,
+                    help="arm the serving fault seams (engine.prefill / "
+                    "engine.decode_step / engine.admit / pool.alloc / "
+                    "http.read) with this ;-separated rule spec — chaos "
+                    "drills only; unset = every seam is an inert None-"
+                    "check")
+    ap.add_argument("--fault_seed", type=int, default=0,
+                    help="seed for p= fault rules in --fault_spec")
     args = ap.parse_args(argv)
+    if args.fault_spec:
+        faults.install(faults.parse_spec(args.fault_spec,
+                                         seed=args.fault_seed))
     srv = PredictServer(args.export_dir, name=args.name, host=args.host,
                         port=args.port, scheduler=args.scheduler,
                         batch_max_size=args.batch_max_size,
@@ -760,7 +904,20 @@ def main(argv=None) -> int:
                         metrics=args.metrics == "on",
                         trace_buffer_events=args.trace_buffer_events,
                         request_log=args.request_log,
-                        thread_sanitizer=args.thread_sanitizer)
+                        thread_sanitizer=args.thread_sanitizer,
+                        default_deadline_ms=args.default_deadline_ms,
+                        drain_timeout_s=args.drain_timeout_s,
+                        stall_after_s=args.stall_after_s)
+
+    def _graceful(signum, frame):
+        # stop() must run off the serve_forever thread (shutdown()
+        # called from inside the loop would deadlock); the drain keeps
+        # the listener up answering 503 until in-flight work finishes
+        threading.Thread(target=srv.stop, name="sigterm-drain",
+                         daemon=True).start()
+
+    import signal
+    signal.signal(signal.SIGTERM, _graceful)
     print(f"serving {srv.name!r} on http://{args.host}:{srv.port}"
           f"/v1/models/{srv.name}:predict", flush=True)
     srv.serve()

@@ -106,6 +106,12 @@ class MetricsLogger:
         if self._tb is not None:
             self._tb.histogram(step, tag, v)
 
+    def flush(self) -> None:
+        """Push buffered JSONL bytes to disk (the serving drain path
+        flushes before the process exits on SIGTERM)."""
+        if self._f is not None:
+            self._f.flush()
+
     def close(self) -> None:
         if self._f is not None:
             self._f.close()

@@ -64,6 +64,15 @@ and dispatch-identical to the plain leg (the disabled default provably
 adds zero dispatches) plus a seeded cross-thread violation probe
 (:func:`thread_sanitizer_check`) proving the sanitizer names the
 offending field and thread.
+
+Round 14 — self-healing: ``--smoke`` also runs a ``chaos_on`` leg —
+the same matrix with a one-shot transient ``engine.decode_step`` fault
+armed through the :mod:`~.runtime.faults` seams. The engine's bounded
+re-dispatch protocol must heal it INVISIBLY: byte parity with the
+fault-disabled leg, identical dispatch counts, exactly one
+``serving_redispatches_total``, zero failed requests (the serving twin
+of the training chaos gate; the full scenario soak lives in
+``experiments/serving_chaos.py``).
 """
 
 import argparse
@@ -499,9 +508,11 @@ def main(argv=None) -> int:
                     help="tier-1 CPU config: 2 clients x 2 requests, "
                     "tiny shapes; runs the slab on/off pair PLUS the "
                     "paged cold/shared legs, an int8 leg (drift "
-                    "bound + equal-bytes capacity), and a THR01 "
+                    "bound + equal-bytes capacity), a THR01 "
                     "thread-sanitizer leg (armed byte/dispatch parity "
-                    "+ seeded cross-thread violation probe), asserting "
+                    "+ seeded cross-thread violation probe), and a "
+                    "chaos_on leg (one-shot transient decode fault "
+                    "healed to byte/dispatch parity), asserting "
                     "paged-vs-slab parity and shared-mode prefill "
                     "savings")
     ap.add_argument("--no_parity", action="store_true",
@@ -656,8 +667,25 @@ def main(argv=None) -> int:
             tsan_caught, _tsan_msg = thread_sanitizer_check(
                 d, matrix[0][0][0])
             tsan_row["tsan_violation_caught"] = tsan_caught
+            # chaos_on leg (round 14): the SAME matrix with a one-shot
+            # transient decode fault armed through the runtime/faults
+            # seams — the engine's bounded re-dispatch must heal it
+            # INVISIBLY: byte parity with the fault-disabled leg
+            # (rows[0]), identical dispatch counts (the retry repeats
+            # an executable call but no scheduler step), exactly one
+            # re-dispatch counted, zero failed requests
+            from distributed_tensorflow_example_tpu.runtime import \
+                faults as _faults
+            _faults.install(_faults.parse_spec(
+                "engine.decode_step:step=2", seed=args.seed))
+            try:
+                chaos_row = run_mode(d, matrix, scheduler="on",
+                                     prompt_len=args.prompt_len,
+                                     mode_name="chaos_on")
+            finally:
+                _faults.install(None)
             rows += [paged_cold, paged_shared, shared_off, int8_row,
-                     tsan_row]
+                     tsan_row, chaos_row]
             checks += [
                 ("tsan_parity_with_unarmed",
                  tsan_row["_gens"] == rows[0]["_gens"]),
@@ -676,6 +704,17 @@ def main(argv=None) -> int:
                 ("int8_drift_within_bound",
                  agreement >= INT8_MIN_AGREEMENT),
                 ("int8_admits_more_than_bf16", cap_int8 > cap_bf16),
+                ("chaos_parity_with_fault_disabled",
+                 chaos_row["_gens"] == rows[0]["_gens"]),
+                ("chaos_dispatch_count_parity",
+                 (chaos_row["decode_steps"], chaos_row["prefills"])
+                 == (rows[0]["decode_steps"], rows[0]["prefills"])),
+                ("chaos_exactly_one_redispatch",
+                 chaos_row["registry"].get(
+                     "serving_redispatches_total") == 1),
+                ("chaos_zero_failed_requests",
+                 chaos_row["registry"].get(
+                     "serving_requests_failed_total") == 0),
             ]
 
     parity = agreement = None

@@ -600,14 +600,17 @@ def test_sanitizer_disabled_keeps_the_plain_class(stepwise_dir):
 
 def test_close_keeps_sanitizer_armed_when_join_times_out(stepwise_dir):
     """Regression: a timed-out join means the scheduler thread is
-    STILL RUNNING — close() must not disarm the sanitizer, so its own
-    teardown touching `_live` raises instead of racing the live
-    scheduler (the exact violation class the sanitizer exists for)."""
+    STILL RUNNING. Round 14 tightened the contract: close() now raises
+    EngineStalledError BEFORE its teardown touches any scheduler-owned
+    state (rounds 9–13 let the teardown run and relied on the armed
+    sanitizer to catch close's own race) — and the sanitizer stays
+    armed past the raise, so a later foreign-thread touch of `_live`
+    still trips ThreadOwnershipError."""
     import threading
 
     from distributed_tensorflow_example_tpu.serving import load_stepwise
     from distributed_tensorflow_example_tpu.serving_batch import (
-        GenerationEngine, ThreadOwnershipError)
+        EngineStalledError, GenerationEngine, ThreadOwnershipError)
 
     eng = GenerationEngine(load_stepwise(stepwise_dir),
                            thread_sanitizer=True)
@@ -622,9 +625,11 @@ def test_close_keeps_sanitizer_armed_when_join_times_out(stepwise_dir):
 
     eng._san_tid = foreign_tid          # scheduler "owns" and is live
     eng._thread = _StuckThread()
-    with pytest.raises(ThreadOwnershipError, match="_live"):
-        eng.close()
+    with pytest.raises(EngineStalledError, match="heartbeat"):
+        eng.close(timeout=0.01)
     assert eng._san_tid == foreign_tid  # still armed
+    with pytest.raises(ThreadOwnershipError, match="_live"):
+        eng._live                       # noqa: B018 — the armed probe
 
 
 def test_http_server_rejects_sanitizer_without_engine(stepwise_dir):

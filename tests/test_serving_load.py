@@ -28,7 +28,7 @@ def test_smoke_runs_and_holds_parity(capsys):
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
                           "paged_shared", "shared_off", "int8_on",
-                          "tsan_on"}
+                          "tsan_on", "chaos_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -66,6 +66,17 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert (tsan["decode_steps"], tsan["prefills"]) == (
         modes["scheduler_on"]["decode_steps"],
         modes["scheduler_on"]["prefills"])
+    # round-14 chaos leg: a one-shot transient decode fault through the
+    # runtime/faults seams heals invisibly — byte parity with the
+    # fault-disabled leg, identical dispatch counts, exactly one
+    # re-dispatch, zero failed requests
+    assert s["chaos_parity_with_fault_disabled"] is True
+    assert s["chaos_dispatch_count_parity"] is True
+    assert s["chaos_exactly_one_redispatch"] is True
+    assert s["chaos_zero_failed_requests"] is True
+    chaos = modes["chaos_on"]
+    assert not chaos["errors"]
+    assert chaos["registry"]["serving_redispatches_total"] == 1
 
 
 def test_smoke_rejects_thread_sanitizer_flag(capsys):
