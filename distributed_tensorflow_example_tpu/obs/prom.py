@@ -80,3 +80,66 @@ def parse(text: str) -> dict[str, float]:
         key, _, val = line.rpartition(" ")
         out[key] = float(val)
     return out
+
+
+def _num(val: str) -> int | float:
+    """Exposition number -> int when it round-trips exactly (counters
+    and gauges rendered from int values must merge back as ints so
+    /stats equality checks stay exact)."""
+    f = float(val)
+    return int(f) if f == int(f) else f
+
+
+def parse_snapshot(text: str) -> dict[str, dict]:
+    """Exposition text -> a :meth:`~.registry.Registry.snapshot`-shaped
+    dict, the exact inverse of :func:`render` — so a FLEET front-end
+    (serving_router.py) can scrape each replica's ``/metrics`` page and
+    combine them through :func:`~.registry.merge_snapshots` without a
+    side channel to the replicas' in-process registries. Histogram
+    ``_bucket`` series are de-cumulated back to per-bucket counts (the
+    snapshot layout merge_snapshots sums); ``# TYPE`` lines drive the
+    record shape; ``# HELP`` text is carried through un-unescaped (it
+    only rides display paths)."""
+    out: dict[str, dict] = {}
+    helps: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, _, h = line[len("# HELP "):].partition(" ")
+            helps[name] = h
+            continue
+        if line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            if kind == "histogram":
+                out[name] = {"type": "histogram", "buckets": [],
+                             "inf": 0, "sum": 0.0, "count": 0,
+                             "help": helps.get(name, "")}
+            else:
+                out[name] = {"type": kind, "value": 0,
+                             "help": helps.get(name, "")}
+            continue
+        if line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        if key in out and out[key]["type"] in ("counter", "gauge"):
+            out[key]["value"] = _num(val)
+            continue
+        # histogram series: <name>_bucket{le="..."} / _sum / _count
+        if key.endswith("_sum") and key[:-4] in out:
+            out[key[:-4]]["sum"] = float(val)
+        elif key.endswith("_count") and key[:-6] in out:
+            out[key[:-6]]["count"] = int(float(val))
+        elif "_bucket{le=" in key:
+            name = key.split("_bucket{le=", 1)[0]
+            rec = out.get(name)
+            if rec is None:
+                continue
+            le = key.split('le="', 1)[1].rstrip('"}')
+            acc = int(float(val))
+            prior = (sum(c for _, c in rec["buckets"]) + rec["inf"])
+            if le == "+Inf":
+                rec["inf"] = acc - prior
+            else:
+                rec["buckets"].append((float(le), acc - prior))
+    return out

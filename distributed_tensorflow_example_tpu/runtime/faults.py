@@ -28,6 +28,9 @@ Spec grammar (``;``-separated rules, ``:``-separated fields)::
     engine.admit:step=1                  # 1st admission fails
     pool.alloc:p=0.01                    # block allocator hiccups
     http.read:step=2                     # 2nd request body read fails
+    router.probe:step=2                  # 2nd fleet health probe fails
+    router.forward:step=3                # 3rd forwarded request drops
+    replica.crash:step=3                 # 3rd forward KILLS its target
 
 The ``engine.*``/``pool.*``/``http.*`` sites are the SERVING seams
 (round 14): they thread the same registry into the continuous-batching
@@ -35,6 +38,13 @@ scheduler's dispatch points, where the engine's quarantine protocol
 (serving_batch.py — fail one request, re-dispatch survivors) is what
 the chaos soak in experiments/serving_chaos.py exercises. Like the
 training seams they are inert-by-default single ``is None`` checks.
+
+The ``router.*``/``replica.*`` sites are the FLEET seams (round 15):
+``router.probe`` fails a health probe, ``router.forward`` drops a
+forwarded request on the network floor, and ``replica.crash`` is the
+kill switch — the router's forward path hard-kills the targeted
+in-process replica and surfaces a connection error, the
+kill-mid-decode scenario experiments/fleet_chaos.py drills.
 
 Fields: ``step=N`` fires on the site's Nth invocation (1-based; for the
 ``step.*`` sites the invocation index IS the global training step) and is
@@ -73,7 +83,10 @@ SITES = ("ckpt.write", "ckpt.commit", "ckpt.read", "loader.next",
          # serving seams (round 14): the generation engine's dispatch
          # points + the HTTP body read — see serving_batch/serving_http
          "engine.prefill", "engine.decode_step", "engine.admit",
-         "pool.alloc", "http.read")
+         "pool.alloc", "http.read",
+         # fleet seams (round 15): the replica router's probe/forward
+         # paths + the kill switch — see serving_router
+         "router.probe", "router.forward", "replica.crash")
 
 #: exceptions a rule may raise — an allowlist so a typo'd spec fails at
 #: parse time, not as a silent never-firing rule

@@ -552,6 +552,16 @@ class RetryAfterEstimator:
         else:
             self.ema_step_s += self.alpha * (step_s - self.ema_step_s)
 
+    @property
+    def seeded(self) -> bool:
+        """True once any completion fed the EMA — the :predict batcher
+        seeds from micro-batch wall time on its FIRST completed batch
+        (a predict-only replica must not answer the 1.0 pre-signal
+        default forever), the engine from decode-step wall time, and
+        the fleet router's per-replica estimators from forward wall
+        time of EITHER verb."""
+        return self.ema_step_s is not None
+
     def estimate(self, steps_to_free: float, *, queue_ahead: int = 0,
                  slots: int = 1) -> float:
         """Seconds until the caller plausibly gets a slot: EMA step
@@ -787,6 +797,13 @@ class GenerationEngine:
         # plain float: atomic to read cross-thread, like
         # _steps_to_free_hint) — the watchdog's signal
         self._heartbeat: float = time.monotonic()
+        # the idle park must wake (and bump the heartbeat) well inside
+        # stall_after_s: at the old fixed 0.5 s granularity an IDLE
+        # engine under a sub-half-second watchdog threshold flapped
+        # live->stalled between wakeups, so a fleet prober
+        # (serving_router) would demote a perfectly healthy replica
+        self._idle_wait_s = (min(0.5, max(0.01, stall_after_s / 4.0))
+                             if stall_after_s > 0 else 0.5)
         # admission sequence for the eviction order (newest first)
         self._admit_counter = 0
         # ---- telemetry: ALL counters live in the registry (one lock,
@@ -1291,7 +1308,7 @@ class GenerationEngine:
             with self._cond:
                 while (self._running and not self._queue
                        and not self._live and not self._cancel_ids):
-                    self._cond.wait(timeout=0.5)
+                    self._cond.wait(timeout=self._idle_wait_s)
                     # idle bump: the watchdog must see a parked-but-
                     # healthy scheduler as live, not stalled
                     self._heartbeat = time.monotonic()

@@ -98,6 +98,13 @@ contract —
 - the ``http.read`` fault seam (``--fault_spec``-driven, inert by
   default) covers the request-read path for the serving chaos soak
   (``experiments/serving_chaos.py``).
+
+Fleet (round 15): N of these servers sit behind
+:class:`~.serving_router.ReplicaRouter` — ``/healthz`` (live/stalled/
+draining) drives the router's replica state machine, ``POST
+/cancel/<rid>`` is the hedging loser-cancellation path, and
+:meth:`PredictServer.kill` is the chaos harness's crash switch
+(listener down NOW, no drain — the ``replica.crash`` seam).
 """
 
 from __future__ import annotations
@@ -115,8 +122,9 @@ from .obs.registry import Registry
 from .runtime import faults
 from .serving import ServableModel, has_stepwise, load_servable
 from .serving_batch import (DeadlineExceededError, DrainingError,
-                            GenerationEngine, MicroBatcher,
-                            QueueFullError, RequestCancelledError)
+                            EngineStalledError, GenerationEngine,
+                            MicroBatcher, QueueFullError,
+                            RequestCancelledError)
 
 
 class _ServerFault(Exception):
@@ -820,6 +828,30 @@ class PredictServer:
                 self.batcher.close()
             if self._request_logger is not None:
                 self._request_logger.close()
+
+    def kill(self) -> None:
+        """Simulate a process crash (the fleet chaos harness's
+        ``replica.crash`` seam): the listener is torn down NOW, the
+        scheduler/batcher failed fast — no drain, no request-log
+        flush, queued and live requests die loudly. Unlike
+        :meth:`stop`, a wedged scheduler is tolerated silently: a real
+        crash takes the wedged thread with it, so raising
+        ``EngineStalledError`` here would make the simulated crash
+        LESS abrupt than the real one."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            if self.engine is not None:
+                self.engine.close(timeout=5)
+            if self.batcher is not None:
+                self.batcher.close(timeout=5)
+        except EngineStalledError:
+            pass
+        if self._request_logger is not None:
+            self._request_logger.close()
 
     def __enter__(self) -> "PredictServer":
         return self.start()

@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Fleet chaos gate: seeded replica-level failures against the router
+with asserted fleet-healing invariants — the fleet twin of
+experiments/serving_chaos.py (one engine) and chaos_soak.py (training).
+
+Each scenario spawns a real in-process fleet (N
+:class:`~.serving_http.PredictServer` replicas over ONE tiny seeded
+paged export behind one :class:`~.serving_router.ReplicaRouter`),
+injects one replica-level failure class — the
+:mod:`~.runtime.faults` fleet seams (``router.probe`` /
+``router.forward`` / ``replica.crash``) or the fleet's own control
+surface (kill/wedge/drain/hedge) — and asserts the round-15 contract:
+
+- ``kill_replica_mid_decode``   — a seeded ``replica.crash`` hard-kills
+                                  one replica while the request wave is
+                                  in flight: ZERO client-visible
+                                  failures, every response byte-matches
+                                  an undisturbed single-replica run,
+                                  the router retried/failed-over, and
+                                  exactly one replica ends dead.
+- ``wedge_one_replica_watchdog``— one replica's decode dispatch wedges:
+                                  its /healthz flips stalled, the
+                                  prober demotes it to degraded, the
+                                  wave lands entirely on the survivors
+                                  to byte parity, and the released
+                                  replica is re-admitted.
+- ``breaker_trip_and_recover``  — a crashed replica's breaker OPENS off
+                                  the probe cadence (no client request
+                                  eaten), traffic heals on the
+                                  survivor, and after a restart the
+                                  half-open probe CLOSES the breaker —
+                                  the replica serves again.
+- ``drain_one_replica_under_load`` — SIGTERM-equivalent drain on one
+                                  replica mid-wave: its in-flight
+                                  requests finish, new admissions route
+                                  around the 503-pushback without
+                                  charging the retry budget, zero
+                                  drops, bytes to parity, the drained
+                                  replica ends dead.
+- ``hedge_cancels_loser``       — a wedged primary forces the hedged
+                                  second attempt to win; the losing
+                                  attempt is CANCELLED through
+                                  POST /cancel/<rid> so the victim
+                                  replica's ``blocks_free`` provably
+                                  returns to baseline (no leaked slot
+                                  or cache blocks).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python experiments/fleet_chaos.py \
+        [--scenario all] [--seed 0] [--smoke]
+
+One JSON line per scenario plus a summary line; nonzero exit on any
+failed invariant. tests/test_fleet_chaos.py runs every scenario in
+tier-1 against one shared export; the CLI soak is the slow-lane twin.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from serving_chaos import (MAX_NEW, _wait, build_chaos_export,
+                           reference_run, seeded_prompts)
+
+from distributed_tensorflow_example_tpu.runtime import faults
+
+
+def _post(port: int, name: str, prompt, *, max_new: int, rid=None,
+          timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:generate",
+        data=json.dumps({"inputs": {"input_ids": [prompt.tolist()]},
+                         "max_new": max_new}).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Request-Id": rid} if rid else {})})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def make_fleet(d: str, n: int, *, server_kw=None, **router_kw):
+    """A started fleet with chaos-friendly cadences: fast probes, fast
+    dead-marking, prefix cache off (the scenarios assert EXACT
+    ``blocks_free`` recovery, and cached prefixes legitimately retain
+    block references)."""
+    from distributed_tensorflow_example_tpu.serving_router import \
+        InProcessFleet
+    router_kw.setdefault("probe_interval_s", 0.05)
+    router_kw.setdefault("dead_after_probes", 2)
+    router_kw.setdefault("retry_budget", 3)
+    skw = dict(server_kw or {})
+    skw.setdefault("prefix_cache", False)
+    return InProcessFleet(d, n, server_kw=skw, **router_kw)
+
+
+def router_post(fleet, prompt, *, max_new: int, rid=None, timeout=120):
+    return _post(fleet.port, fleet.name, prompt, max_new=max_new,
+                 rid=rid, timeout=timeout)
+
+
+def replica_stats(fleet, i: int) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{fleet.servers[i].port}/stats",
+            timeout=30) as r:
+        return json.loads(r.read())["generate"]
+
+
+def router_counters(fleet) -> dict:
+    snap = fleet.router.registry.snapshot()
+    return {k: rec["value"] for k, rec in snap.items()
+            if rec["type"] in ("counter", "gauge")}
+
+
+def _drive_wave(fleet, prompts, max_new: int):
+    """Concurrent client wave via the router; returns (generations,
+    served_by, errors) index-aligned with ``prompts``."""
+    outs: list = [None] * len(prompts)
+    served: list = [None] * len(prompts)
+    errors: list = []
+
+    def client(i):
+        try:
+            resp = router_post(fleet, prompts[i], max_new=max_new,
+                               rid=f"wave-{i}")
+            outs[i] = resp["generations"][0]
+            served[i] = resp.get("served_by")
+        except Exception as e:     # noqa: BLE001 — the invariant IS
+            errors.append(f"request {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outs, served, errors
+
+
+# ---------------------------------------------------------------------------
+# scenarios — each returns (detail, metrics)
+# ---------------------------------------------------------------------------
+
+def scenario_kill_replica_mid_decode(d: str, seed: int, vocab: int):
+    prompts = seeded_prompts(6, seed + 10, vocab)
+    ref = reference_run(d, prompts, max_new=8)
+    # one-shot: the 3rd forwarded request's target replica is KILLED
+    # (listener torn down, engine failed fast) while the rest of the
+    # wave is in flight on it
+    faults.install(faults.parse_spec("replica.crash:step=3", seed=seed))
+    try:
+        fleet = make_fleet(d, 3)
+        try:
+            outs, served, errors = _drive_wave(fleet, prompts,
+                                               max_new=8)
+            assert not errors, f"client-visible failures: {errors}"
+            assert outs == ref, \
+                "failover changed greedy bytes vs the undisturbed run"
+            met = router_counters(fleet)
+            assert met["router_retries_total"] >= 1, met
+            _wait(lambda: list(
+                fleet.router.replica_states().values()).count("dead")
+                == 1, what="exactly one replica marked dead")
+            dead = [n for n, s in
+                    fleet.router.replica_states().items()
+                    if s == "dead"]
+            return (f"replica {dead[0]} killed mid-wave; 6/6 requests "
+                    f"served to byte parity with "
+                    f"{met['router_retries_total']} retry(ies), "
+                    f"{met['router_failovers_total']} failover(s)",
+                    met)
+        finally:
+            fleet.close()
+    finally:
+        faults.install(None)
+
+
+def scenario_wedge_one_replica_watchdog(d: str, seed: int, vocab: int):
+    prompts = seeded_prompts(4, seed + 11, vocab)
+    ref = reference_run(d, prompts, max_new=6)
+    # stall_after_s small so the wedge is detectable fast; the round-15
+    # idle-wait fix keeps an IDLE engine's heartbeat well inside it
+    fleet = make_fleet(d, 3, server_kw={"stall_after_s": 0.2,
+                                        "prefix_cache": False})
+    # warm every replica first: the FIRST prefill/decode dispatch pays
+    # XLA compilation (hundreds of ms), which a 0.2 s watchdog would
+    # misread as a stall — the scenario is about a WEDGED dispatch,
+    # not about compile cost
+    for srv in fleet.servers:
+        _post(srv.port, srv.name, prompts[0], max_new=2)
+    wedged, release = threading.Event(), threading.Event()
+    srv0 = fleet.servers[0]
+    orig = srv0.engine.sw.decode
+
+    def wedge(feats):
+        wedged.set()
+        release.wait(timeout=60)
+        return orig(feats)
+
+    srv0.engine.sw.decode = wedge
+    try:
+        # wedge replica0 with a DIRECT request (an external actor —
+        # the router never saw it), then prove the fleet routes around
+        # the stalled watchdog
+        direct: dict = {}
+
+        def direct_post():
+            try:
+                direct["out"] = _post(srv0.port, srv0.name,
+                                      prompts[0], max_new=6)
+            except Exception as e:   # noqa: BLE001 — recorded
+                direct["err"] = f"{type(e).__name__}: {e}"
+
+        th = threading.Thread(target=direct_post)
+        th.start()
+        assert wedged.wait(timeout=30), "decode never dispatched"
+        _wait(lambda: fleet.router.replica_states()["replica0"]
+              == "degraded",
+              what="prober demoting the wedged replica")
+        outs, served, errors = _drive_wave(fleet, prompts, max_new=6)
+        assert not errors, f"client-visible failures: {errors}"
+        assert outs == ref, "survivor routing changed greedy bytes"
+        assert set(filter(None, served)) <= {"replica1", "replica2"}, \
+            f"a request landed on the wedged replica: {served}"
+        _wait(lambda: router_counters(fleet)["router_replica_healthy"]
+              == 2, what="gauge settling at 2 healthy survivors")
+        met = router_counters(fleet)
+        release.set()
+        th.join(timeout=60)
+        assert direct.get("out") is not None, direct
+        _wait(lambda: fleet.router.replica_states()["replica0"]
+              == "healthy", what="released replica re-admitted")
+        return (f"wedged replica0 demoted to degraded in-probe; 4/4 "
+                f"requests served by survivors to byte parity; "
+                "released replica re-admitted as healthy", met)
+    finally:
+        release.set()
+        fleet.close()
+
+
+def scenario_breaker_trip_and_recover(d: str, seed: int, vocab: int):
+    prompts = seeded_prompts(3, seed + 12, vocab)
+    ref = reference_run(d, prompts, max_new=4)
+    fleet = make_fleet(d, 2, breaker_threshold=2,
+                       breaker_cooldown_s=0.2)
+    try:
+        warm = router_post(fleet, prompts[0], max_new=4)
+        assert warm["generations"][0] == ref[0]
+        fleet.crash(0)
+        rep0 = fleet.router.replicas[0]
+        _wait(lambda: rep0.breaker.state == "open",
+              what="breaker opening off the probe cadence")
+        _wait(lambda: fleet.router.replica_states()["replica0"]
+              == "dead", what="crashed replica marked dead")
+        met = router_counters(fleet)
+        assert met["router_breaker_open_total"] >= 1, met
+        outs, served, errors = _drive_wave(fleet, prompts, max_new=4)
+        assert not errors, f"failures while breaker open: {errors}"
+        assert outs == ref, "survivor bytes diverged"
+        assert set(filter(None, served)) == {"replica1"}, served
+        fleet.restart(0)
+        _wait(lambda: fleet.router.replica_states()["replica0"]
+              == "healthy" and rep0.breaker.state == "closed",
+              what="half-open probe closing the breaker")
+        outs2, served2, errors2 = _drive_wave(fleet, prompts,
+                                              max_new=4)
+        assert not errors2 and outs2 == ref, (errors2, "parity")
+        assert "replica0" in set(served2), \
+            f"recovered replica took no traffic: {served2}"
+        met = router_counters(fleet)
+        return (f"crash opened replica0's breaker via probes "
+                f"(opens={met['router_breaker_open_total']}); "
+                "survivor served the wave to parity; restart + "
+                "half-open probe closed the breaker and replica0 "
+                "serves again", met)
+    finally:
+        fleet.close()
+
+
+def scenario_drain_one_replica_under_load(d: str, seed: int,
+                                          vocab: int):
+    prompts = seeded_prompts(9, seed + 13, vocab)
+    ref = reference_run(d, prompts, max_new=4)
+    fleet = make_fleet(d, 3,
+                       server_kw={"drain_timeout_s": 60.0,
+                                  "prefix_cache": False})
+    try:
+        outs: list = [None] * len(prompts)
+        errors: list = []
+
+        def client(i):
+            try:
+                outs[i] = router_post(fleet, prompts[i],
+                                      max_new=4)["generations"][0]
+            except Exception as e:   # noqa: BLE001 — recorded
+                errors.append(f"request {i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads[:4]:
+            t.start()
+        # SIGTERM-equivalent mid-wave: replica0 drains gracefully
+        # (listener up answering 503 while its in-flight work finishes)
+        drainer = threading.Thread(
+            target=lambda: fleet.servers[0].stop(drain=True))
+        drainer.start()
+        for t in threads[4:]:
+            t.start()
+        for t in threads:
+            t.join()
+        drainer.join(timeout=120)
+        assert not errors, f"dropped requests under drain: {errors}"
+        assert outs == ref, "drain changed greedy bytes"
+        _wait(lambda: fleet.router.replica_states()["replica0"]
+              == "dead", what="drained replica leaving the fleet")
+        met = router_counters(fleet)
+        assert met["router_replica_healthy"] == 2, met
+        return ("9/9 requests to byte parity across a mid-wave "
+                "graceful drain; drained replica excluded then dead; "
+                "2 replicas left healthy", met)
+    finally:
+        fleet.close()
+
+
+def scenario_hedge_cancels_loser(d: str, seed: int, vocab: int):
+    prompts = seeded_prompts(1, seed + 14, vocab)
+    ref = reference_run(d, prompts, max_new=4)
+    fleet = make_fleet(d, 2, hedge_after_ms=60)
+    wedged, release = threading.Event(), threading.Event()
+    srv0 = fleet.servers[0]
+    orig = srv0.engine.sw.decode
+
+    def wedge(feats):
+        wedged.set()
+        release.wait(timeout=60)
+        return orig(feats)
+
+    try:
+        free0 = replica_stats(fleet, 0)["blocks_free"]
+        srv0.engine.sw.decode = wedge
+        # both replicas idle -> the tie-break picks replica0, which
+        # wedges; the hedge fires at 60 ms and replica1 wins
+        resp = router_post(fleet, prompts[0], max_new=4,
+                           rid="hedge-rid")
+        assert wedged.is_set(), "primary never reached replica0"
+        assert resp["generations"][0] == ref[0], \
+            "hedged response diverged from the undisturbed run"
+        assert resp["served_by"] == "replica1", resp["served_by"]
+        assert resp["request_ids"] == ["hedge-rid"], \
+            resp["request_ids"]
+        met = router_counters(fleet)
+        assert met["router_hedges_total"] == 1, met
+        release.set()
+        # the loser was cancelled through POST /cancel/<rid>: its slot
+        # and cache blocks must come back — NOT decode to max_new
+        _wait(lambda: replica_stats(fleet, 0)["blocks_free"] == free0,
+              what="loser replica's blocks_free returning to baseline")
+        s0 = replica_stats(fleet, 0)
+        assert s0["cancelled"] == 1, s0
+        assert s0["requests_done"] == 0, s0
+        return (f"hedge won on replica1 (bytes to parity, same "
+                f"request id end-to-end); loser cancelled on "
+                f"replica0 — blocks_free back to {free0}, "
+                f"cancelled=1, requests_done=0", met)
+    finally:
+        release.set()
+        fleet.close()
+
+
+SCENARIOS = {
+    "kill_replica_mid_decode": scenario_kill_replica_mid_decode,
+    "wedge_one_replica_watchdog": scenario_wedge_one_replica_watchdog,
+    "breaker_trip_and_recover": scenario_breaker_trip_and_recover,
+    "drain_one_replica_under_load": scenario_drain_one_replica_under_load,
+    "hedge_cancels_loser": scenario_hedge_cancels_loser,
+}
+
+
+def run_scenarios(names, *, seed: int, export_dir: str | None = None,
+                  vocab: int | None = None) -> list[dict]:
+    """Build the shared ample-pool export (unless the caller passes a
+    pre-built one — the tier-1 tests amortize ONE export), run
+    ``names``, return one result dict per scenario."""
+    import tempfile
+    results = []
+    with tempfile.TemporaryDirectory() as scratch:
+        d = export_dir
+        if d is None:
+            d = os.path.join(scratch, "fleet")
+            vocab = build_chaos_export(d, seed=seed)
+        assert vocab is not None, \
+            "pass vocab= alongside a pre-built export dir"
+        for name in names:
+            try:
+                detail, met = SCENARIOS[name](d, seed, vocab)
+                results.append({"scenario": name, "ok": True,
+                                "detail": detail, "metrics": met})
+            except Exception as e:   # a failed invariant is the signal
+                results.append({"scenario": name, "ok": False,
+                                "detail": f"{type(e).__name__}: {e}",
+                                "metrics": {}})
+            finally:
+                faults.install(None)
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="all",
+                    help="comma-separated scenario names, or 'all': "
+                         + ", ".join(SCENARIOS))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="alias kept for symmetry with serving_chaos "
+                    "(the fleets are already CPU-tiny; --smoke changes "
+                    "nothing today)")
+    args = ap.parse_args(argv)
+    names = (list(SCENARIOS) if args.scenario == "all"
+             else [s.strip() for s in args.scenario.split(",")
+                   if s.strip()])
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        ap.error(f"unknown scenario(s) {unknown}; have {list(SCENARIOS)}")
+    results = run_scenarios(names, seed=args.seed)
+    for r in results:
+        print(json.dumps(r), flush=True)
+    failed = sum(1 for r in results if not r["ok"])
+    print(json.dumps({"summary": True, "scenarios": len(results),
+                      "failed": failed, "max_new_cap": MAX_NEW,
+                      "smoke": bool(args.smoke)}))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -383,6 +383,95 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     return row
 
 
+def run_router_mode(export_dir: str, matrix, *, replicas: int = 2,
+                    mode_name: str = "router_on",
+                    **router_kw) -> dict:
+    """Drive the closed-loop client matrix through a replica ROUTER
+    fronting ``replicas`` in-process servers over the same export —
+    the fleet leg: tps/p95 next to the single-replica rows, fleet
+    counters from the merged ``/metrics`` page, and the same ``_gens``
+    stash for the byte-parity check (greedy output must not depend on
+    which replica serves)."""
+    from distributed_tensorflow_example_tpu.serving_router import \
+        InProcessFleet
+
+    clients = len(matrix)
+    lat: list[list[float]] = [[] for _ in range(clients)]
+    gens: list[list[list[int]]] = [[] for _ in range(clients)]
+    # per-client rows, aggregated after join — a shared dict's
+    # read-modify-write would race across client threads
+    served_rows: list[list[str]] = [[] for _ in range(clients)]
+    errors: list[str] = []
+    fleet = InProcessFleet(export_dir, replicas, **router_kw)
+    try:
+        def client(ci):
+            for prompt, m in matrix[ci]:
+                payload = {"inputs": {"input_ids": [prompt.tolist()]},
+                           "max_new": m}
+                t0 = time.perf_counter()
+                try:
+                    out = _post(fleet.port, fleet.name, "generate",
+                                payload)
+                except Exception as e:      # noqa: BLE001 — recorded
+                    errors.append(f"client {ci}: {type(e).__name__}: "
+                                  f"{e}")
+                    return
+                lat[ci].append(time.perf_counter() - t0)
+                gens[ci].append(out["generations"][0][:m])
+                served_rows[ci].append(out.get("served_by", "?"))
+
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        registry = _prom(fleet.port)        # fleet-merged /metrics
+    finally:
+        fleet.close()
+
+    served: dict[str, int] = {}
+    for row in served_rows:
+        for by in row:
+            served[by] = served.get(by, 0) + 1
+    flat_lat = sorted(x for row in lat for x in row)
+    n_req = len(flat_lat)
+    n_tok = sum(len(g) for row in gens for g in row)
+
+    def pctl(q):
+        if not flat_lat:
+            return 0.0
+        i = min(n_req - 1, int(round(q / 100 * (n_req - 1))))
+        return flat_lat[i] * 1e3
+
+    return {
+        "mode": mode_name,
+        "replicas": replicas,
+        "clients": clients,
+        "requests": n_req,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(n_tok / wall, 2) if wall else 0.0,
+        "requests_per_s": round(n_req / wall, 3) if wall else 0.0,
+        "latency_p50_ms": round(pctl(50), 2),
+        "latency_p95_ms": round(pctl(95), 2),
+        "latency_p99_ms": round(pctl(99), 2),
+        "served_by": dict(sorted(served.items())),
+        # fleet-level counters: replica registries + the router's own,
+        # merged by the /metrics page itself
+        "decode_steps": int(registry.get("serving_decode_steps_total",
+                                         0)),
+        "prefills": int(registry.get("serving_prefills_total", 0)),
+        "router_requests": int(registry.get("router_requests_total",
+                                            0)),
+        "router_retries": int(registry.get("router_retries_total", 0)),
+        "router_hedges": int(registry.get("router_hedges_total", 0)),
+        "_gens": gens,
+    }
+
+
 def int8_capacity_check(*, prompt_len: int, max_new: int, seed: int,
                         block_size: int) -> tuple[int, int]:
     """THE equal-bytes capacity probe: export a bf16 and an int8 paged
@@ -510,11 +599,19 @@ def main(argv=None) -> int:
                     "paged cold/shared legs, an int8 leg (drift "
                     "bound + equal-bytes capacity), a THR01 "
                     "thread-sanitizer leg (armed byte/dispatch parity "
-                    "+ seeded cross-thread violation probe), and a "
+                    "+ seeded cross-thread violation probe), a "
                     "chaos_on leg (one-shot transient decode fault "
-                    "healed to byte/dispatch parity), asserting "
+                    "healed to byte/dispatch parity), and a router_on "
+                    "leg (2-replica fleet behind serving_router, byte "
+                    "parity with the single-replica row), asserting "
                     "paged-vs-slab parity and shared-mode prefill "
                     "savings")
+    ap.add_argument("--router", type=int, default=0,
+                    help="also run a fleet leg: N in-process replicas "
+                    "over the same export behind serving_router's "
+                    "ReplicaRouter (tps/p95 vs the single-replica "
+                    "rows, byte parity asserted); 0 = off (--smoke "
+                    "always runs a 2-replica leg)")
     ap.add_argument("--no_parity", action="store_true",
                     help="skip the on-vs-off byte-identity assertion")
     ap.add_argument("--thread_sanitizer", action="store_true",
@@ -538,6 +635,19 @@ def main(argv=None) -> int:
                  "needs rows[0] unarmed for the armed-vs-unarmed "
                  "parity/zero-dispatch checks — arming every leg would "
                  "make them vacuous; drop --thread_sanitizer")
+    if args.router and args.smoke:
+        ap.error("--smoke already runs its own 2-replica router leg — "
+                 "drop --router, or run a full-matrix fleet leg "
+                 "without --smoke")
+    if args.router and (args.weight_quant != "off"
+                        or args.kv_cache_dtype != "auto"):
+        ap.error("--router compares the fleet leg byte-for-byte "
+                 "against the single-replica scheduler-on row, which "
+                 "the LOSSY quant legs cannot satisfy — run them "
+                 "separately")
+    if args.router < 0:
+        ap.error(f"--router takes a replica count >= 0, got "
+                 f"{args.router}")
     if args.smoke:
         args.clients, args.requests = 2, 2
         args.slots, args.prompt_len, args.max_new = 2, 8, 4
@@ -684,9 +794,20 @@ def main(argv=None) -> int:
                                      mode_name="chaos_on")
             finally:
                 _faults.install(None)
+            # router leg (round 15): the same matrix through a
+            # 2-replica fleet — greedy bytes must not depend on which
+            # replica serves (or on the router being in the path)
+            router_row = run_router_mode(d, matrix, replicas=2)
             rows += [paged_cold, paged_shared, shared_off, int8_row,
-                     tsan_row, chaos_row]
+                     tsan_row, chaos_row, router_row]
             checks += [
+                ("router_parity_with_single_replica",
+                 router_row["_gens"] == rows[0]["_gens"]),
+                ("router_zero_client_failures",
+                 not router_row["errors"]),
+                ("router_counts_every_request",
+                 router_row["router_requests"]
+                 == router_row["requests"]),
                 ("tsan_parity_with_unarmed",
                  tsan_row["_gens"] == rows[0]["_gens"]),
                 ("tsan_zero_dispatch_delta",
@@ -715,6 +836,18 @@ def main(argv=None) -> int:
                 ("chaos_zero_failed_requests",
                  chaos_row["registry"].get(
                      "serving_requests_failed_total") == 0),
+            ]
+        elif args.router:
+            # the full-matrix fleet leg: N replicas, same matrix,
+            # byte parity against the single-replica scheduler-on row
+            router_row = run_router_mode(d, matrix,
+                                         replicas=args.router)
+            rows.append(router_row)
+            checks += [
+                ("router_parity_with_single_replica",
+                 router_row["_gens"] == rows[0]["_gens"]),
+                ("router_zero_client_failures",
+                 not router_row["errors"]),
             ]
 
     parity = agreement = None

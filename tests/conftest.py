@@ -59,8 +59,17 @@ def build_telemetry_summary() -> str:
     if not names:
         return ""
     dead = sorted(n for n, touched in names.items() if not touched)
-    line = (f"TELEMETRY: {len(names)} registry metric(s) seen, "
-            f"{len(names) - len(dead)} exercised")
+    # per-subsystem breakdown by leading name token (serving_* /
+    # predict_* / router_* / training metrics) so a whole subsystem
+    # going silent is visible at a glance, not just the global count
+    prefixes: dict[str, int] = {}
+    for n in names:
+        p = n.split("_", 1)[0]
+        prefixes[p] = prefixes.get(p, 0) + 1
+    by_prefix = " ".join(f"{p}:{c}" for p, c in
+                         sorted(prefixes.items()))
+    line = (f"TELEMETRY: {len(names)} registry metric(s) seen "
+            f"[{by_prefix}], {len(names) - len(dead)} exercised")
     if dead:
         line += (f", {len(dead)} DEAD (registered but never "
                  f"incremented by the suite): {dead}")

@@ -1,0 +1,138 @@
+"""Fleet chaos gate (round 15): every seeded replica-level scenario
+from experiments/fleet_chaos.py runs in tier-1 against one shared
+export, plus the router-level seam-inertness parity regression (the
+PR-9/PR-14 armed-vs-plain pattern extended to the new fleet seams).
+The CLI soak is the slow-lane twin.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "experiments", "fleet_chaos.py")
+sys.path.insert(0, os.path.join(ROOT, "experiments"))
+
+import fleet_chaos  # noqa: E402
+import serving_chaos  # noqa: E402
+
+from distributed_tensorflow_example_tpu.runtime import faults  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fleet_dir(tmp_path_factory):
+    """ONE ample-pool paged export shared by every fleet scenario."""
+    d = str(tmp_path_factory.mktemp("fleet"))
+    vocab = serving_chaos.build_chaos_export(d, seed=0)
+    return d, vocab
+
+
+def _assert_ok(results):
+    bad = [r for r in results if not r["ok"]]
+    assert not bad, f"fleet scenario(s) failed: {bad}"
+
+
+def test_fleet_kill_and_wedge(fleet_dir):
+    """The acceptance core: killing or wedging one of three replicas
+    mid-request yields ZERO client-visible failures and greedy bytes
+    identical to an undisturbed single-replica run."""
+    d, vocab = fleet_dir
+    results = fleet_chaos.run_scenarios(
+        ["kill_replica_mid_decode", "wedge_one_replica_watchdog"],
+        seed=0, export_dir=d, vocab=vocab)
+    _assert_ok(results)
+    kill = results[0]
+    assert kill["metrics"]["router_retries_total"] >= 1
+
+
+def test_fleet_breaker_trip_and_recover(fleet_dir):
+    """The victim's breaker opens off the probe cadence and recovers
+    via the half-open probe after a restart."""
+    d, vocab = fleet_dir
+    results = fleet_chaos.run_scenarios(
+        ["breaker_trip_and_recover"], seed=0, export_dir=d,
+        vocab=vocab)
+    _assert_ok(results)
+    assert results[0]["metrics"]["router_breaker_open_total"] >= 1
+
+
+def test_fleet_drain_under_load(fleet_dir):
+    d, vocab = fleet_dir
+    results = fleet_chaos.run_scenarios(
+        ["drain_one_replica_under_load"], seed=0, export_dir=d,
+        vocab=vocab)
+    _assert_ok(results)
+    assert results[0]["metrics"]["router_replica_healthy"] == 2
+
+
+def test_fleet_hedge_cancels_loser(fleet_dir):
+    """A hedged request's losing attempt is provably cancelled: the
+    victim replica's blocks_free returns to baseline (asserted inside
+    the scenario) and exactly one hedge was launched."""
+    d, vocab = fleet_dir
+    results = fleet_chaos.run_scenarios(
+        ["hedge_cancels_loser"], seed=0, export_dir=d, vocab=vocab)
+    _assert_ok(results)
+    assert results[0]["metrics"]["router_hedges_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: fleet seams join the armed-vs-plain inertness contract
+# ---------------------------------------------------------------------------
+
+def test_router_seams_inert_when_silent(fleet_dir):
+    """A fault registry whose router.probe / router.forward /
+    replica.crash rules never fire must leave the fleet byte-identical
+    to no registry at all, with zero retries/hedges/breaker-opens —
+    the armed-but-silent seams provably cost zero behavior."""
+    d, vocab = fleet_dir
+    prompts = serving_chaos.seeded_prompts(3, 17, vocab)
+
+    def run(spec):
+        if spec:
+            faults.install(faults.parse_spec(spec, seed=0))
+        try:
+            fleet = fleet_chaos.make_fleet(d, 2)
+            try:
+                outs, _, errors = fleet_chaos._drive_wave(
+                    fleet, prompts, max_new=3)
+                assert not errors, errors
+                met = fleet_chaos.router_counters(fleet)
+                return outs, (met["router_retries_total"],
+                              met["router_hedges_total"],
+                              met["router_breaker_open_total"],
+                              met["router_failovers_total"])
+            finally:
+                fleet.close()
+        finally:
+            faults.install(None)
+
+    plain = run(None)
+    armed = run("router.probe:step=999999;router.forward:step=999999;"
+                "replica.crash:step=999999")
+    assert plain == armed
+    assert plain[1] == (0, 0, 0, 0)
+
+
+@pytest.mark.slow
+def test_fleet_chaos_cli_all_scenarios():
+    """The registered slow gate: the full CLI soak in a fresh
+    process."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--scenario", "all"],
+        env=env, capture_output=True, text=True, timeout=1200,
+        cwd=ROOT)
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    assert rows, f"no output in {time.monotonic() - t0:.0f}s:\n" \
+                 f"{out.stdout}\n{out.stderr[-2000:]}"
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = [r for r in rows if r.get("summary")][0]
+    assert summary["failed"] == 0
+    assert summary["scenarios"] == len(fleet_chaos.SCENARIOS)

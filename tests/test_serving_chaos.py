@@ -383,7 +383,9 @@ def test_serving_seams_inert_when_silent(chaos_dir):
     plain = run(None)
     armed = run("engine.decode_step:step=999999;"
                 "engine.prefill:step=999999;engine.admit:step=999999;"
-                "pool.alloc:step=999999;http.read:step=999999")
+                "pool.alloc:step=999999;http.read:step=999999;"
+                "router.probe:step=999999;router.forward:step=999999;"
+                "replica.crash:step=999999")
     assert plain == armed
 
 

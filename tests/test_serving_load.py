@@ -28,7 +28,7 @@ def test_smoke_runs_and_holds_parity(capsys):
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
                           "paged_shared", "shared_off", "int8_on",
-                          "tsan_on", "chaos_on"}
+                          "tsan_on", "chaos_on", "router_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -77,6 +77,17 @@ def test_smoke_runs_and_holds_parity(capsys):
     chaos = modes["chaos_on"]
     assert not chaos["errors"]
     assert chaos["registry"]["serving_redispatches_total"] == 1
+    # round-15 router leg: a 2-replica fleet behind serving_router
+    # serves the same matrix byte-identically (greedy output cannot
+    # depend on which replica answers) with zero client failures
+    assert s["router_parity_with_single_replica"] is True
+    assert s["router_zero_client_failures"] is True
+    assert s["router_counts_every_request"] is True
+    router = modes["router_on"]
+    assert router["replicas"] == 2 and not router["errors"]
+    assert router["tokens_per_s"] > 0 and router["latency_p95_ms"] > 0
+    assert router["router_requests"] == router["requests"] == 4
+    assert sum(router["served_by"].values()) == 4
 
 
 def test_smoke_rejects_thread_sanitizer_flag(capsys):
@@ -140,6 +151,29 @@ def test_full_load_matrix():
     assert summary["dispatch_ratio"] > 1.0, (
         "continuous batching did not share decode steps: "
         f"{summary}")
+
+
+@pytest.mark.slow
+def test_full_load_matrix_router():
+    """Slow-lane fleet leg: the full client matrix through a
+    3-replica router — byte parity with the single-replica row plus
+    tps/p95 published for the fleet-vs-single comparison."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--clients", "8", "--requests", "3",
+         "--slots", "8", "--prompt_len", "12", "--max_new", "8",
+         "--router", "3"],
+        env=env, capture_output=True, text=True, timeout=1200, cwd=ROOT)
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, f"no output:\n{out.stdout}\n{out.stderr[-2000:]}"
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = [r for r in rows if r.get("summary")][0]
+    assert summary["ok"] and summary["greedy_parity"] is True
+    assert summary["router_parity_with_single_replica"] is True
+    router = [r for r in rows if r.get("mode") == "router_on"][0]
+    assert router["replicas"] == 3 and not router["errors"]
+    assert router["tokens_per_s"] > 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,618 @@
+"""Replica router (round 15): breaker state machine, routing policy,
+request-id propagation across failover, pushback propagation, fleet
+observability, and the measured Retry-After seeding satellites.
+
+The breaker tests run against an injected clock — no ``time.sleep``
+drives any state transition in tier-1.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "experiments"))
+
+import serving_chaos  # noqa: E402
+
+from distributed_tensorflow_example_tpu.obs import prom  # noqa: E402
+from distributed_tensorflow_example_tpu.obs.registry import (  # noqa: E402
+    Registry, merge_snapshots)
+from distributed_tensorflow_example_tpu.runtime import faults  # noqa: E402
+from distributed_tensorflow_example_tpu.serving_router import (  # noqa: E402
+    CircuitBreaker, ForwardError, InProcessFleet, Replica,
+    ReplicaRouter)
+
+
+@pytest.fixture(scope="module")
+def fleet_dir(tmp_path_factory):
+    """ONE tiny paged export shared by every HTTP-level router test."""
+    d = str(tmp_path_factory.mktemp("router"))
+    vocab = serving_chaos.build_chaos_export(d, seed=0)
+    return d, vocab
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _post(port, name, payload, request_id=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}:generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Request-Id": request_id} if request_id
+                    else {})})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# satellite: breaker state machine, deterministic via injected clock
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_breaker_opens_on_consecutive_threshold():
+    clk = FakeClock()
+    b = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=clk)
+    assert b.state == "closed" and b.allow()
+    assert b.record_failure() is False
+    assert b.record_failure() is False
+    assert b.record_failure() is True        # 3rd consecutive: opens
+    assert b.state == "open"
+    assert not b.allow()                     # cooling down
+    # a success resets the consecutive count while closed
+    b2 = CircuitBreaker(threshold=3, cooldown_s=5.0, clock=clk)
+    b2.record_failure()
+    b2.record_failure()
+    b2.record_success()
+    assert b2.record_failure() is False and b2.state == "closed"
+
+
+def test_breaker_opens_on_error_rate():
+    clk = FakeClock()
+    b = CircuitBreaker(threshold=100, error_rate=0.5, window=8,
+                       min_samples=6, cooldown_s=5.0, clock=clk)
+    # alternate success/failure: never 100 consecutive, but the window
+    # hits 50% failures once min_samples exist
+    opened = False
+    for _ in range(4):
+        b.record_success()
+        opened = b.record_failure() or opened
+    assert opened and b.state == "open"
+
+
+def test_breaker_half_open_single_probe_then_close():
+    clk = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clk)
+    assert b.record_failure() is True and b.state == "open"
+    assert not b.allow()                     # cooldown not elapsed
+    clk.advance(5.1)
+    assert b.allow()                         # THE half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()                     # single probe at a time
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_breaker_reopens_on_probe_failure():
+    clk = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_s=5.0, clock=clk)
+    b.record_failure()
+    clk.advance(5.1)
+    assert b.allow() and b.state == "half_open"
+    assert b.record_failure() is True        # probe failed: re-open
+    assert b.state == "open" and not b.allow()
+    clk.advance(5.1)                         # cooldown restarted
+    assert b.allow() and b.state == "half_open"
+
+
+def test_breaker_rejects_bad_params():
+    with pytest.raises(ValueError, match="threshold"):
+        CircuitBreaker(threshold=0)
+    with pytest.raises(ValueError, match="error_rate"):
+        CircuitBreaker(error_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# satellite: measured Retry-After on a predict-only replica
+# ---------------------------------------------------------------------------
+
+def test_microbatcher_retry_after_seeded_from_first_batch(tmp_path):
+    """A replica that only ever serves ``:predict`` must NOT answer the
+    1.0 pre-signal default forever: the estimator seeds from micro-
+    batch wall time on the FIRST completed batch, so a later 429
+    carries the measured estimate."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.config import TrainConfig
+    from distributed_tensorflow_example_tpu.models import get_model
+    from distributed_tensorflow_example_tpu.serving import (
+        export_model, load_servable, serving_signature)
+    from distributed_tensorflow_example_tpu.serving_batch import (
+        MicroBatcher, QueueFullError)
+    d = str(tmp_path / "predict")
+    m = get_model("mlp", TrainConfig(model="mlp"))
+    out = m.init(jax.random.key(0))
+    params, extras = out if isinstance(out, tuple) else (out, {})
+    export_model(m, params, extras, d, platforms=("cpu",))
+    feats = serving_signature(m.dummy_batch(4))
+    x = np.asarray(feats["x"])
+    mb = MicroBatcher(load_servable(d), batch_max_size=1,
+                      batch_max_wait_ms=1.0, max_queue=2).start()
+    try:
+        assert not mb._retry.seeded
+        # one COMPLETED batch seeds the estimator from wall time
+        mb.submit({"x": x[:1]}, 1).result(timeout=60)
+        _wait(lambda: mb._retry.seeded, what="estimator seeding")
+        ema = mb._retry.ema_step_s
+        assert ema is not None and ema > 0
+        # wedge the dispatch so the queue fills, then assert the 429
+        # hint is the MEASURED estimate, not the pre-signal 1.0
+        wedged, release = threading.Event(), threading.Event()
+        inner = mb.servable
+
+        def wedge(cols):
+            wedged.set()
+            release.wait(timeout=60)
+            return inner(cols)
+
+        mb.servable = wedge
+        try:
+            futs = [mb.submit({"x": x[:1]}, 1)]
+            assert wedged.wait(timeout=30)
+            futs += [mb.submit({"x": x[:1]}, 1) for _ in range(2)]
+            with pytest.raises(QueueFullError) as e:
+                mb.submit({"x": x[:1]}, 1)
+            expect = round(mb._retry.estimate(
+                1.0, queue_ahead=2, slots=1), 2)
+            assert e.value.retry_after == expect, \
+                "429 hint is not the measured estimate"
+        finally:
+            release.set()
+            for f in futs:
+                f.result(timeout=60)
+    finally:
+        mb.close()
+
+
+def test_replica_estimator_feeds_from_any_forward():
+    """The router-side mirror of the same rule: a replica's wait hint
+    is 0 (admissible) before any signal, and measured after ANY
+    completed forward — :predict batches included."""
+    r = Replica("http://127.0.0.1:9", name="p")
+    assert r.wait_hint_s(outstanding=5) == 0.0     # no signal: admit
+    r.observe(0.2)                                 # first completion
+    assert r.retry.seeded
+    assert r.wait_hint_s(outstanding=0) == pytest.approx(0.2)
+    assert r.wait_hint_s(outstanding=3) == pytest.approx(0.8)
+
+
+# ---------------------------------------------------------------------------
+# routing policy units (no fleet, no start())
+# ---------------------------------------------------------------------------
+
+def _bare_router(n=3, **kw):
+    reps = [Replica(f"http://127.0.0.1:{i + 1}", name=f"r{i}")
+            for i in range(n)]
+    router = ReplicaRouter(reps, name="m", **kw)
+    for rep in reps:
+        router._states[rep.name] = "healthy"
+    return router, reps
+
+
+def test_pick_least_outstanding_tie_breaks_by_order():
+    router, reps = _bare_router(3)
+    try:
+        router._outstanding = {"r0": 2, "r1": 0, "r2": 1}
+        assert router._pick(set(), None) is reps[1]
+        router._outstanding = {"r0": 0, "r1": 0, "r2": 0}
+        assert router._pick(set(), None) is reps[0]
+        assert router._pick({"r0"}, None) is reps[1]
+    finally:
+        router.close()
+
+
+def test_pick_skips_inadmissible_states_and_open_breakers():
+    router, reps = _bare_router(3)
+    try:
+        router._states.update({"r0": "dead", "r1": "draining"})
+        assert router._pick(set(), None) is reps[2]
+        # breaker open and cooling: nothing admissible once r2 is out
+        reps[2].breaker._state = "open"
+        reps[2].breaker._opened_at = reps[2].breaker.clock()
+        assert router._pick(set(), None) is None
+        # cooldown elapsed: r2 is granted as the half-open trial
+        reps[2].breaker._opened_at -= 100.0
+        assert router._pick(set(), None) is reps[2]
+        assert reps[2].breaker.state == "half_open"
+    finally:
+        router.close()
+
+
+def test_pick_is_deadline_aware():
+    """Never pick a replica whose measured queue wave exceeds the
+    request's remaining deadline."""
+    router, reps = _bare_router(2)
+    try:
+        reps[0].observe(0.5)                  # 500 ms measured wave
+        router._outstanding = {"r0": 1, "r1": 3}
+        # 200 ms left: r0's hint is 0.5*(1+1)=1000 ms -> skipped even
+        # though it has fewer outstanding; r1 is unmeasured (hint 0)
+        assert router._pick(set(), 200.0) is reps[1]
+        # no deadline: least-outstanding wins as usual
+        assert router._pick(set(), None) is reps[0]
+        # both measured beyond the budget: nothing admissible
+        reps[1].observe(0.5)
+        assert router._pick(set(), 200.0) is None
+    finally:
+        router.close()
+
+
+GEN_PATH = "/v1/models/m:generate"
+GEN_PAYLOAD = {"inputs": {"input_ids": [[1, 2]]}}
+
+
+def test_half_open_trial_pushback_releases_probe_slot():
+    """Review regression: a half-open trial request that hits 429
+    pushback must release the breaker's single probe slot (the replica
+    answered — it is responsive), not quarantine the replica forever
+    with allow() returning False for every future probe."""
+    router, reps = _bare_router(1)
+    try:
+        rep = reps[0]
+        rep.breaker = CircuitBreaker(threshold=1, cooldown_s=0.0)
+        rep.breaker.record_failure()              # open, cooldown 0
+        router._forward = lambda r, path, body, rid, t: (
+            429, {"Retry-After": "2"}, b'{"error": "full"}')
+        st, headers, _ = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
+                                       "rid-po", True)
+        assert st == 429 and headers["Retry-After"] == "2"
+        # the trial released the slot AND counted as responsiveness:
+        # the breaker is closed again, not wedged half-open
+        assert rep.breaker.state == "closed"
+        router._forward = lambda r, path, body, rid, t: (
+            200, {}, b'{"generations": [[9]]}')
+        st, _, body = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
+                                    "rid-po2", True)
+        assert st == 200
+        assert json.loads(body)["served_by"] == "r0"
+    finally:
+        router.close()
+
+
+def test_hedged_double_failure_excludes_both_replicas():
+    """Review regression: when BOTH hedged attempts fail, the retry
+    loop must not re-pick either of them — before the fix only the
+    last-failing replica was excluded and the budget burned on a
+    known-dead one."""
+    router, reps = _bare_router(3, hedge_after_ms=10, retry_budget=2,
+                                backoff_base_ms=1.0, backoff_cap_ms=2.0)
+    try:
+        calls = []
+
+        def fake_forward(r, path, body, rid, timeout_s):
+            calls.append(r.name)
+            if r.name == "r0":
+                time.sleep(0.05)
+                raise ForwardError(r, "conn reset")
+            if r.name == "r1":
+                raise ForwardError(r, "conn refused")
+            return 200, {}, b'{"generations": [[7]]}'
+
+        router._forward = fake_forward
+        st, _, body = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
+                                    "rid-h2", True)
+        assert st == 200
+        assert json.loads(body)["served_by"] == "r2"
+        # exactly one forward per replica: the post-hedge retry went
+        # STRAIGHT to r2 instead of re-trying the failed hedge pair
+        assert sorted(calls) == ["r0", "r1", "r2"], calls
+        snap = router.registry.snapshot()
+        assert snap["router_retries_total"]["value"] == 1
+        assert snap["router_hedges_total"]["value"] == 1
+    finally:
+        router.close()
+
+
+def test_float_deadline_ms_honored_and_decremented_on_failover():
+    """Review regression: a float ``deadline_ms`` (any client doing
+    wall-clock math sends one; the replica knob accepts it) must drive
+    the router's deadline handling — before the fix it was silently
+    ignored and every failover restarted the client's full budget."""
+    router, _ = _bare_router(2, retry_budget=2, backoff_base_ms=1.0,
+                             backoff_cap_ms=2.0)
+    try:
+        seen = []
+
+        def fake_forward(r, path, body, rid, timeout_s):
+            seen.append(json.loads(body)["deadline_ms"])
+            if len(seen) == 1:
+                time.sleep(0.05)
+                raise ForwardError(r, "conn reset")
+            return 200, {}, b'{"generations": [[2]]}'
+
+        router._forward = fake_forward
+        st, _, _ = router._serve(
+            GEN_PATH, {**GEN_PAYLOAD, "deadline_ms": 5000.0},
+            "rid-fd", True)
+        assert st == 200
+        # every forward carries the REMAINING budget as an int, and
+        # the failover's share is visibly smaller than the first's
+        assert all(isinstance(d, int) for d in seen), seen
+        assert seen[0] <= 5000
+        assert seen[1] <= seen[0] - 50, seen
+    finally:
+        router.close()
+
+
+def test_hedge_pushback_waits_for_sibling_never_cancels():
+    """Review regression: a hedged wave whose primary answers 429 must
+    wait for the in-flight sibling (which may win outright) instead of
+    returning the pushback — and must never fire the async loser
+    cancellation, which raced the same-rid retry and could cancel the
+    client's fresh attempt."""
+    router, reps = _bare_router(2, hedge_after_ms=10)
+    try:
+        cancels, calls = [], []
+        router._cancel_on = lambda r, rids: cancels.append(r.name)
+
+        def fake_forward(r, path, body, rid, timeout_s):
+            calls.append(r.name)
+            if r.name == "r0":
+                time.sleep(0.05)
+                return 429, {"Retry-After": "2"}, b'{"error": "full"}'
+            time.sleep(0.15)
+            return 200, {}, b'{"generations": [[3]]}'
+
+        router._forward = fake_forward
+        st, _, body = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
+                                    "rid-hp", True)
+        assert st == 200
+        assert json.loads(body)["served_by"] == "r1"
+        # exactly one forward per replica — the pushback neither
+        # re-submitted the rid nor cancelled the winning sibling
+        assert sorted(calls) == ["r0", "r1"], calls
+        assert cancels == []
+        # the pushback replica's breaker saw a response (responsive),
+        # so a half-open trial slot could never leak here either
+        assert reps[0].breaker.state == "closed"
+    finally:
+        router.close()
+
+
+def test_hedge_winner_observes_its_own_wall_time():
+    """Review regression: the hedge winner's estimator must be fed its
+    OWN forward wall time — not the hedge delay plus the primary's
+    wait, which would train the fastest replica's EMA toward
+    hedge_after_ms and mis-steer the deadline-aware skip."""
+    router, reps = _bare_router(2, hedge_after_ms=20)
+    try:
+        def fake_forward(r, path, body, rid, timeout_s):
+            time.sleep(0.3 if r.name == "r0" else 0.01)
+            return 200, {}, b'{"generations": [[1]]}'
+
+        router._forward = fake_forward
+        st, _, body = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
+                                    "rid-hw", True)
+        assert st == 200
+        assert json.loads(body)["served_by"] == "r1"
+        assert router.registry.snapshot()[
+            "router_hedges_total"]["value"] == 1
+        # the winner's EMA reflects its ~10 ms forward, not the
+        # ~20 ms hedge delay + wait; the slow loser stays unobserved
+        assert reps[1].retry.ema_step_s < 0.15
+        assert reps[0].retry.ema_step_s is None
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: X-Request-Id end-to-end, surviving a failover
+# ---------------------------------------------------------------------------
+
+def test_request_id_survives_failover_retry(fleet_dir):
+    """The SAME rid rides the retry onto the second replica after the
+    first forward drops — and the response names the replica that
+    actually served."""
+    d, vocab = fleet_dir
+    p = serving_chaos.seeded_prompts(1, 4, vocab)[0]
+    faults.install(faults.parse_spec("router.forward:step=1", seed=0))
+    try:
+        with InProcessFleet(d, 2, probe_interval_s=0.05) as fleet:
+            out = _post(fleet.port, fleet.name,
+                        {"inputs": {"input_ids": [p.tolist()]},
+                         "max_new": 3}, request_id="rid-failover")
+            # first pick is replica0 (idle tie-break); its forward is
+            # dropped by the seam, the retry lands on replica1
+            assert out["request_ids"] == ["rid-failover"]
+            assert out["served_by"] == "replica1"
+            snap = fleet.router.registry.snapshot()
+            assert snap["router_retries_total"]["value"] == 1
+            assert snap["router_failovers_total"]["value"] == 1
+            # the dropped forward fed replica0's breaker (one failure:
+            # still closed at the default threshold)
+            assert fleet.router.replicas[0].breaker.state == "closed"
+    finally:
+        faults.install(None)
+
+
+def test_failover_bytes_match_direct_single_replica(fleet_dir):
+    """Greedy output must be byte-identical no matter which replica
+    serves or how many failovers occurred."""
+    d, vocab = fleet_dir
+    prompts = serving_chaos.seeded_prompts(2, 5, vocab)
+    ref = serving_chaos.reference_run(d, prompts, max_new=4)
+    faults.install(faults.parse_spec("router.forward:step=2", seed=0))
+    try:
+        with InProcessFleet(d, 2, probe_interval_s=0.05) as fleet:
+            outs = [_post(fleet.port, fleet.name,
+                          {"inputs": {"input_ids": [p.tolist()]},
+                           "max_new": 4})["generations"][0]
+                    for p in prompts]
+            assert outs == ref
+    finally:
+        faults.install(None)
+
+
+# ---------------------------------------------------------------------------
+# pushback propagation + fleet observability
+# ---------------------------------------------------------------------------
+
+def test_pushback_propagates_with_min_retry_after(fleet_dir):
+    """When EVERY admissible replica answers 429, the router
+    propagates 429 with the smallest Retry-After seen."""
+    from distributed_tensorflow_example_tpu.serving_batch import \
+        QueueFullError
+    d, _ = fleet_dir
+    with InProcessFleet(d, 2, probe_interval_s=0.05) as fleet:
+        def full_26(payload, request_id=None):
+            raise QueueFullError("full", retry_after=2.6)
+
+        def full_71(payload, request_id=None):
+            raise QueueFullError("full", retry_after=7.1)
+
+        fleet.servers[0].generate = full_26
+        fleet.servers[1].generate = full_71
+        try:
+            _post(fleet.port, fleet.name,
+                  {"inputs": {"input_ids": [[1, 2]]}})
+            raise AssertionError("fleet-wide pushback not surfaced")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            assert e.headers.get("Retry-After") == "3"   # min(2.6,7.1)
+            assert "pushed back" in json.loads(e.read())["error"]
+
+
+def test_fleet_metrics_merge_replica_pages(fleet_dir):
+    """GET /metrics on the router merges every replica's exposition
+    with the router's own registry through merge_snapshots; the first
+    request also pins client X-Request-Id propagation end-to-end."""
+    d, vocab = fleet_dir
+    prompts = serving_chaos.seeded_prompts(3, 6, vocab)
+    with InProcessFleet(d, 2, probe_interval_s=0.05) as fleet:
+        out = _post(fleet.port, fleet.name,
+                    {"inputs": {"input_ids": [prompts[0].tolist()]},
+                     "max_new": 2}, request_id="rid-e2e")
+        assert out["request_ids"] == ["rid-e2e"]
+        assert out["timings"][0]["request_id"] == "rid-e2e"
+        assert out["served_by"] in ("replica0", "replica1")
+        for p in prompts[1:]:
+            _post(fleet.port, fleet.name,
+                  {"inputs": {"input_ids": [p.tolist()]}, "max_new": 2})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.port}/metrics",
+                timeout=30) as r:
+            merged = prom.parse(r.read().decode())
+        # counters SUM across the fleet regardless of which replica
+        # served which request
+        assert merged["serving_requests_done_total"] == 3
+        assert merged["router_requests_total"] == 3
+        assert merged["router_replica_healthy"] == 2
+        # histogram series merge too (count sums across replicas)
+        assert merged["serving_request_latency_seconds_count"] == 3
+        # /stats nests both replicas next to the router block
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.port}/stats",
+                timeout=30) as r:
+            stats = json.loads(r.read())
+        assert set(stats["replicas"]) == {"replica0", "replica1"}
+        done = sum(rep["generate"]["requests_done"]
+                   for rep in stats["replicas"].values())
+        assert done == 3
+        assert stats["router"]["requests"] == 3
+
+
+def test_prom_parse_snapshot_roundtrip():
+    """parse_snapshot is the exact inverse of render: a parsed page
+    merges with the original snapshot (counters double, histogram
+    buckets double, gauges hold)."""
+    reg = Registry()
+    reg.counter("rt_probe_total", "help text").inc(3)
+    reg.gauge("rt_probe_depth").set(7)
+    h = reg.histogram("rt_probe_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = reg.snapshot()
+    parsed = prom.parse_snapshot(prom.render(snap))
+    assert parsed["rt_probe_total"] == {
+        "type": "counter", "value": 3, "help": "help text"}
+    assert parsed["rt_probe_depth"]["value"] == 7
+    assert parsed["rt_probe_seconds"]["buckets"] == [(0.1, 1), (1.0, 1)]
+    assert parsed["rt_probe_seconds"]["inf"] == 1
+    assert parsed["rt_probe_seconds"]["count"] == 3
+    merged = merge_snapshots(snap, parsed)
+    assert merged["rt_probe_total"]["value"] == 6
+    assert merged["rt_probe_depth"]["value"] == 7
+    assert merged["rt_probe_seconds"]["count"] == 6
+    prom.render(merged)                       # still renderable
+
+
+def test_router_healthz_reflects_fleet(fleet_dir):
+    d, _ = fleet_dir
+    with InProcessFleet(d, 2, probe_interval_s=0.05,
+                        dead_after_probes=2) as fleet:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.port}/healthz",
+                timeout=30) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert body["status"] == "live"
+        assert {rep["state"] for rep in body["replicas"].values()} \
+            == {"healthy"}
+        fleet.crash(0)
+        fleet.crash(1)
+        _wait(lambda: all(
+            s == "dead"
+            for s in fleet.router.replica_states().values()),
+            what="whole fleet marked dead")
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.port}/healthz", timeout=30)
+            raise AssertionError("healthz stayed 200 with no replica")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["status"] == "unserved"
+
+
+def test_router_cli_requires_replicas(capsys):
+    from distributed_tensorflow_example_tpu import serving_router
+    with pytest.raises(SystemExit):
+        serving_router.main([])
+    assert "--replica" in capsys.readouterr().err
+
+
+def test_router_rejects_bad_config():
+    with pytest.raises(ValueError, match="at least one"):
+        ReplicaRouter([], name="m")
+    with pytest.raises(ValueError, match="duplicate"):
+        ReplicaRouter([Replica("http://a", name="x"),
+                       Replica("http://b", name="x")], name="m")
+    with pytest.raises(ValueError, match="retry_budget"):
+        ReplicaRouter([Replica("http://a")], retry_budget=-1)
+    with pytest.raises(ValueError, match="hedge_after_ms"):
+        ReplicaRouter([Replica("http://a")], hedge_after_ms=-5)
