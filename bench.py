@@ -529,6 +529,23 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
         irow = serving_load.run_mode(d, shared, scheduler="on",
                                      prompt_len=prompt_len,
                                      mode_name="int8_shared")
+    # speculative leg (round 16): the repetitive workload the
+    # self-drafter mines, against a verify-program export —
+    # `{key}_serving_spec_tps` / `{key}_serving_accept_rate` are the
+    # next TPU window's baselines for the ROADMAP item-1 verdict
+    # (tokens-per-dispatch uplift at the measured accept rate)
+    with tempfile.TemporaryDirectory() as d:
+        serving_load.build_export(
+            d, prompt_len=prompt_len, max_new=max_new, slots=slots,
+            model_name=model_name, platforms=platforms, paged=True,
+            block_size=block_size, spec_tokens=4)
+        rep = serving_load.make_repetitive_requests(
+            clients, requests, prompt_len=prompt_len, max_new=max_new,
+            vocab=vocab, seed=0)
+        srow = serving_load.run_mode(d, rep, scheduler="on",
+                                     prompt_len=prompt_len,
+                                     mode_name="spec_on",
+                                     spec_tokens=4)
     # counters come from the registry snapshot each run_mode captured
     # (the /metrics exposition = the same atomic snapshot /stats
     # renders) — not re-derived from response bookkeeping, so the
@@ -561,6 +578,18 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
             preg.get("serving_bytes_resident_peak", 0)),
         "serving_int8_bytes_resident_peak": int(
             ireg.get("serving_bytes_resident_peak", 0)),
+        # round-16 speculative columns: throughput on the repetitive
+        # workload, the measured accept rate, and the dispatch economy
+        # (emitted tokens per dispatch — > 1.0 is the whole point)
+        "serving_spec_tps": srow["tokens_per_s"],
+        "serving_accept_rate": float(
+            srow["registry"].get("serving_spec_accept_rate", 0.0)),
+        "serving_spec_errors": len(srow["errors"]),
+        "serving_spec_tokens_per_dispatch": round(
+            int(srow["registry"]["serving_tokens_out_total"])
+            / max(1, int(srow["registry"]["serving_decode_steps_total"])
+                  + int(srow["registry"]["serving_verify_steps_total"])
+                  + int(srow["registry"]["serving_prefills_total"])), 3),
     }
     # per-request latency breakdown (queue vs prefill vs decode) from
     # the request-scoped `timings` field — the p95 gate's diagnosis

@@ -871,6 +871,56 @@ class GPT:
         h = nn.layernorm(params["ln_f"], h)
         return self.lm_logits(params, h[:, None])[:, 0], out_pools
 
+    def decode_verify_batched_paged(self, params, stacked, pools,
+                                    block_tables, tok, pos, pad,
+                                    alive, n_tok,
+                                    decode_attention: str | None = None):
+        """K-token VERIFY step for speculative decoding: row b carries
+        ``tok[b] = [anchor, draft_1, ..., draft_{K-1}]`` — the anchor is
+        the token a normal decode step would dispatch (its KV is not in
+        the pool yet), the drafts are the self-drafter's proposals.
+        Lane j writes its K/V at logical slot ``pos[b] + j`` through the
+        block table and its logits predict the token at ``pos[b]+j+1``,
+        so the host can accept the longest draft prefix that matches the
+        greedy argmax chain and rewind ``pos`` past the rest.
+
+        Implemented as :meth:`decode_step_batched_paged` over ROW-
+        EXPANDED inputs: lane (b, j) becomes an independent row at
+        ``pos[b] + j`` sharing row b's block table. Within one layer the
+        scan body writes every row's K/V into the pool BEFORE the
+        attention gather, so lane j's window (``slots <= pos[b]+j``)
+        already contains lanes 0..j-1's keys — exactly the state a
+        sequential dispatch of the same tokens would have produced. The
+        verify step therefore inherits the batched step's byte-parity
+        contract (rows are computationally independent) AND its whole
+        quantization surface: int8 stacked weights and the int8 paged
+        pool (quantize-on-write + fused-dequant gathers) run unchanged.
+
+        ``tok``: [B, K] int32; ``pos``/``pad``/``alive``: [B];
+        ``n_tok``: [B] int32 in [1, K] — lanes >= ``n_tok[b]`` are
+        write-gated like dead rows (they rewrite old bytes; their
+        logits are computed but the host ignores them), which is how
+        draftless/sampled slots ride the same dispatch at width 1.
+        Distinct lanes of one row write distinct (block, offset) pairs
+        (positions ``pos..pos+K-1`` are consecutive), so the expanded
+        scatter has no intra-row write collision. Returns
+        (``logits [B, K, V]``, updated pools)."""
+        b, kk = tok.shape
+        lanes = jnp.arange(kk, dtype=jnp.int32)
+        pos = jnp.asarray(pos, jnp.int32)
+        n_tok = jnp.asarray(n_tok, jnp.int32)
+        alive = (jnp.asarray(alive) != 0)
+        pos_e = (pos[:, None] + lanes[None, :]).reshape(-1)
+        pad_e = jnp.repeat(jnp.asarray(pad, jnp.int32), kk)
+        alive_e = (alive[:, None]
+                   & (lanes[None, :] < n_tok[:, None])).reshape(-1)
+        bt_e = jnp.repeat(jnp.asarray(block_tables, jnp.int32), kk,
+                          axis=0)
+        logits, new = self.decode_step_batched_paged(
+            params, stacked, pools, bt_e, tok.reshape(-1), pos_e,
+            pad_e, alive_e, decode_attention=decode_attention)
+        return logits.reshape(b, kk, -1), new
+
     def _stack_caches(self, caches):
         """Per-layer {layer_i: {k, v}} prefill caches -> the stacked
         {"k": [L, ...], "v": [L, ...]} slabs the scan step consumes."""

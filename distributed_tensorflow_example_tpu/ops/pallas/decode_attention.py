@@ -136,6 +136,14 @@ def xla_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 # the exact slab reference math), the kernel with scalar-prefetch index maps
 # (the block id is read from SMEM before each K/V block's DMA is issued — no
 # gathered [B, T, H, D] tensor ever exists).
+#
+# K-query speculative verify (round 16): the verify program presents BOTH
+# impls with row-expanded queries — K lanes of one slot become K rows at
+# consecutive `pos` values sharing one block-table row (repeated in
+# `block_tables`). Neither impl needs a special case: rows are independent
+# by construction, which is exactly the property the engine's exact-accept
+# rule rides; kernel-vs-gather parity on the expanded shape is pinned in
+# tests/test_paged_serving.py.
 # ---------------------------------------------------------------------------
 
 def paged_tile_friendly(block_size: int, head_dim: int) -> bool:

@@ -41,6 +41,11 @@ _META = "export.json"
 # engine (serving_batch.py) drives, beside the monolithic artifact
 _PREFILL = "prefill.stablehlo"
 _DECODE = "decode.stablehlo"
+# K-token speculative-verify program (export_generator spec_tokens=K,
+# paged stepwise artifacts only): the engine's draft-and-verify loop
+# dispatches it instead of decode.stablehlo on iterations where any
+# live slot carries draft tokens
+_VERIFY = "verify.stablehlo"
 
 
 def serving_signature(batch: dict[str, Any]) -> dict[str, Any]:
@@ -197,6 +202,7 @@ def export_generator(model, params, out_dir: str, *,
                      weight_quant: str | None = None,
                      kv_cache_dtype: str | None = None,
                      pool_bytes: int | None = None,
+                     spec_tokens: int = 0,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Serialize ``model.generate`` (params baked; greedy or
     temperature/top-k/top-p sampling, optional EOS early-stop) as a
@@ -286,7 +292,19 @@ def export_generator(model, params, out_dir: str, *,
     Every generator export records ``quant_schema`` + ``weight_quant``
     (and, stepwise, ``kv_cache_dtype`` / ``kv_scale_shape``) so
     loaders can validate quant expectations loudly instead of
-    shape-erroring deep in the scan."""
+    shape-erroring deep in the scan.
+
+    ``spec_tokens=K`` (K >= 2; requires ``paged=True``) additionally
+    exports ``verify.stablehlo`` — the K-token speculative-verify
+    program (``GPT.decode_verify_batched_paged``): per-row ``[K]``
+    token inputs through the same stacked-scan fast path into the
+    paged pool, returning ``[slots, K, V]`` logits, with lanes gated
+    per-row by ``n_tok`` so draftless slots ride the dispatch at width
+    1. Composes with ``weight_quant="int8"`` and
+    ``kv_cache_dtype="int8"`` unchanged (the verify body IS the decode
+    body over row-expanded inputs). ``spec_tokens`` lands in the
+    ``stepwise`` metadata so the engine and the HTTP server can
+    auto-detect spec capability."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
 
@@ -309,6 +327,18 @@ def export_generator(model, params, out_dir: str, *,
         if pool_bytes < 1:
             raise ValueError(f"pool_bytes must be >= 1, got "
                              f"{pool_bytes}")
+    if spec_tokens:
+        if spec_tokens < 2:
+            raise ValueError(
+                f"spec_tokens must be 0 (off) or >= 2 (one anchor "
+                f"token + at least one draft lane per verify "
+                f"dispatch), got {spec_tokens}")
+        if not paged:
+            raise ValueError(
+                "spec_tokens exports the K-token verify program over "
+                "the block-paged pool (draft rejection rewinds per-row "
+                "pos through the block tables) — export with "
+                "paged=True, or drop the knob")
 
     sampled = temperature > 0.0
     tpu_only_on_tpu = (tuple(platforms) == ("tpu",)
@@ -362,7 +392,8 @@ def export_generator(model, params, out_dir: str, *,
             decode_attention=decode_attention, platforms=platforms,
             paged=paged, block_size=block_size, num_blocks=num_blocks,
             weight_quant=weight_quant, cache_dtype=cache_dtype,
-            kv_quant=kv_quant, pool_bytes=pool_bytes)
+            kv_quant=kv_quant, pool_bytes=pool_bytes,
+            spec_tokens=spec_tokens)
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
                            prompt_len=prompt_len,
@@ -379,20 +410,26 @@ def export_generator(model, params, out_dir: str, *,
 def _trace_and_write_stepwise(out_dir: str, prefill_fn, decode_fn,
                               prefill_specs: dict, decode_specs: dict,
                               platforms: Sequence[str],
-                              base_meta: dict, **extra_meta) -> dict:
+                              base_meta: dict, verify_fn=None,
+                              verify_specs: dict | None = None,
+                              **extra_meta) -> dict:
     """The shared tail of both stepwise exporters (slab and paged):
-    trace + serialize the prefill/decode pair to the canonical
-    filenames (chief-only write) and assemble the ``stepwise``
-    metadata block. ONE copy, so an export-flow change (donation
-    hints, platform knobs, a new metadata key the engine reads) cannot
-    silently diverge the two artifact kinds."""
-    prefill_exp = jax_export.export(
-        jax.jit(prefill_fn), platforms=list(platforms))(prefill_specs)
-    decode_exp = jax_export.export(
-        jax.jit(decode_fn), platforms=list(platforms))(decode_specs)
+    trace + serialize the prefill/decode pair (plus the optional
+    speculative-verify program) to the canonical filenames (chief-only
+    write) and assemble the ``stepwise`` metadata block. ONE copy, so
+    an export-flow change (donation hints, platform knobs, a new
+    metadata key the engine reads) cannot silently diverge the two
+    artifact kinds."""
+    programs = [(_PREFILL, prefill_fn, prefill_specs),
+                (_DECODE, decode_fn, decode_specs)]
+    if verify_fn is not None:
+        programs.append((_VERIFY, verify_fn, verify_specs))
+    exported = [(name, jax_export.export(
+        jax.jit(fn), platforms=list(platforms))(specs))
+        for name, fn, specs in programs]
     if jax.process_index() == 0:
         os.makedirs(out_dir, exist_ok=True)
-        for name, exp in ((_PREFILL, prefill_exp), (_DECODE, decode_exp)):
+        for name, exp in exported:
             with open(os.path.join(out_dir, name), "wb") as f:
                 f.write(exp.serialize())
     return {**base_meta, **extra_meta}
@@ -406,7 +443,8 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
                      num_blocks: int | None = None,
                      weight_quant: str | None = None,
                      cache_dtype=None, kv_quant: str | None = None,
-                     pool_bytes: int | None = None) -> dict:
+                     pool_bytes: int | None = None,
+                     spec_tokens: int = 0) -> dict:
     """Trace + serialize the prefill and shared-decode-step programs
     (see :func:`export_generator` ``stepwise=True``); returns the
     ``stepwise`` metadata block. Params are already host-gathered."""
@@ -441,7 +479,7 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
             block_size=block_size, num_blocks=num_blocks,
             cache_dtype=cache_dtype, base_meta=base_meta,
             weight_quant=weight_quant, kv_quant=kv_quant,
-            pool_bytes=pool_bytes)
+            pool_bytes=pool_bytes, spec_tokens=spec_tokens)
     head_dim = c.hidden // c.heads
     pool_shape = (c.layers, slots, total, c.heads, head_dim)
 
@@ -494,7 +532,8 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
                            num_blocks: int | None, cache_dtype,
                            base_meta, weight_quant: str | None = None,
                            kv_quant: str | None = None,
-                           pool_bytes: int | None = None) -> dict:
+                           pool_bytes: int | None = None,
+                           spec_tokens: int = 0) -> dict:
     """The block-paged stepwise pair (``export_generator``
     ``paged=True``): prefill writes a prompt's whole blocks through a
     table row, the shared decode step reads/writes through per-slot
@@ -596,6 +635,29 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
         "block_tables": jax.ShapeDtypeStruct((slots, blocks_per_slot),
                                              np.int32),
         **pool_specs}
+    verify_fn = verify_specs = None
+    if spec_tokens:
+        def verify_fn(feats):
+            pools = {"k": feats["cache_k"], "v": feats["cache_v"]}
+            if kv_quant:
+                pools.update({"k_scale": feats["cache_k_scale"],
+                              "v_scale": feats["cache_v_scale"]})
+            logits, new = model.decode_verify_batched_paged(
+                params, stacked, pools,
+                feats["block_tables"], feats["tok"], feats["pos"],
+                feats["pad"], feats["alive"], feats["n_tok"],
+                decode_attention=decode_attention)
+            out = {"logits": logits, "cache_k": new["k"],
+                   "cache_v": new["v"]}
+            if kv_quant:
+                out.update({"cache_k_scale": new["k_scale"],
+                            "cache_v_scale": new["v_scale"]})
+            return out
+
+        verify_specs = {
+            **{k: v for k, v in decode_specs.items() if k != "tok"},
+            "tok": jax.ShapeDtypeStruct((slots, spec_tokens), np.int32),
+            "n_tok": jax.ShapeDtypeStruct((slots,), np.int32)}
     quant_meta = {}
     if kv_quant:
         quant_meta = {"kv_scale_shape": list(scale_shape),
@@ -603,9 +665,11 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
     return _trace_and_write_stepwise(
         out_dir, prefill_fn, decode_fn, prefill_specs, decode_specs,
         platforms, base_meta(pool_shape),
+        verify_fn=verify_fn, verify_specs=verify_specs,
         paged=True, block_size=block_size, num_blocks=num_blocks,
         blocks_per_slot=blocks_per_slot, prompt_blocks=prompt_blocks,
-        layout="left_aligned", block_bytes=block_bytes, **quant_meta)
+        layout="left_aligned", block_bytes=block_bytes,
+        spec_tokens=spec_tokens, **quant_meta)
 
 
 def validate_quant_meta(meta: dict, *, where: str = "artifact") -> None:
@@ -727,10 +791,24 @@ class StepwiseGenerator:
         #: along in make_pool/_split), else the storage float dtype
         self.kv_cache_dtype: str = str(
             step_meta.get("kv_cache_dtype", step_meta["cache_dtype"]))
+        #: K of the exported speculative-verify program (0 = the export
+        #: carries none — the engine must run spec-off)
+        self.spec_tokens: int = int(step_meta.get("spec_tokens", 0))
+        verify_path = os.path.join(directory, _VERIFY)
+        if self.spec_tokens and not os.path.exists(verify_path):
+            raise ValueError(
+                f"{directory!r} metadata claims spec_tokens="
+                f"{self.spec_tokens} but {_VERIFY} is missing — the "
+                "export is torn; re-export with export_generator(..., "
+                f"spec_tokens={self.spec_tokens})")
         with open(os.path.join(directory, _PREFILL), "rb") as f:
             self._prefill_exp = jax_export.deserialize(f.read())
         with open(os.path.join(directory, _DECODE), "rb") as f:
             self._decode_exp = jax_export.deserialize(f.read())
+        self._verify_exp = None
+        if self.spec_tokens:
+            with open(verify_path, "rb") as f:
+                self._verify_exp = jax_export.deserialize(f.read())
         # donate ONLY the pool (the multi-megabyte operand): donating
         # the whole feature dict would warn per-call about the small
         # int arrays XLA can't alias into the outputs
@@ -743,6 +821,9 @@ class StepwiseGenerator:
                                 donate_argnums=(0,))
         self._decode = jax.jit(split(self._decode_exp.call),
                                donate_argnums=(0,))
+        self._verify = (jax.jit(split(self._verify_exp.call),
+                                donate_argnums=(0,))
+                        if self._verify_exp is not None else None)
 
     def make_pool(self) -> dict:
         """A zeroed cache pool of the exported shape (the engine's
@@ -777,6 +858,18 @@ class StepwiseGenerator:
     def decode(self, feats: dict) -> dict:
         pool, rest = self._split(feats)
         return self._decode(pool, rest)
+
+    def verify(self, feats: dict) -> dict:
+        """The K-token speculative-verify dispatch (``tok`` is
+        [slots, spec_tokens]; adds ``n_tok`` [slots]) — only on
+        artifacts exported with ``spec_tokens >= 2``."""
+        if self._verify is None:
+            raise ValueError(
+                "this artifact was exported without a verify program "
+                "(spec_tokens=0) — re-export with export_generator("
+                "..., spec_tokens=K) to enable speculative decoding")
+        pool, rest = self._split(feats)
+        return self._verify(pool, rest)
 
 
 def load_stepwise(directory: str) -> StepwiseGenerator:

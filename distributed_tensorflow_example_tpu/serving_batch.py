@@ -93,6 +93,36 @@ Observables: ``serving_cancelled_total`` /
 ``experiments/serving_chaos.py`` is the seeded soak gate over all of
 it (tier-1 fast smoke in tests/test_serving_chaos.py).
 
+Round 16 — speculative decoding (self-drafting + one-dispatch verify):
+decode is weight-bound, so verifying K draft tokens in ONE batched
+dispatch costs about the same HBM traffic as one token. With
+``spec_tokens=K`` (artifact exported with a verify program —
+``export_generator(..., spec_tokens=K)``, paged only) each live GREEDY
+slot owns a host-side :class:`NgramDrafter` (prompt-lookup over its
+prompt + generated tokens — no second model); iterations where any
+slot has a draft dispatch the K-token verify program instead of the
+single-token step, with draftless/sampled/teacher-forced slots riding
+the same dispatch at lane width 1. Acceptance is the EXACT greedy
+rejection rule — accept the longest draft prefix matching the argmax
+chain, then emit the correction (first mismatch's argmax) or the bonus
+token — so greedy output is byte-identical to non-speculative decode;
+a rejection just rewinds the slot's ``pos`` (left-aligned paged layout:
+nothing to release unless the secured write span crossed a block
+boundary, in which case the trailing fresh block refs return to the
+pool). Sampled requests never draft (exact-rule speculation is a
+greedy contract; their per-token host RNG stream is untouched).
+``spec_tokens=0`` (default) is a bitwise no-op: the drafting pass is
+skipped entirely, dispatch counts and pool bytes are identical.
+Observables: ``serving_spec_proposed/accepted/emitted_total``,
+``serving_verify_steps_total``, the ``serving_spec_accept_rate`` gauge
+(all in ``/stats`` + ``/metrics``), and per-request ``spec_accepted``
+in the ``timings`` breakdown. The verify dispatch runs under the SAME
+``engine.decode_step`` fault seam and bounded re-dispatch protocol as
+the normal step. :class:`RetryAfterEstimator` converts remaining
+ROW-STEPS to dispatches through a measured tokens-per-dispatch EMA, so
+429 Retry-After stops overestimating by ~1/accept_rate once
+speculation lands.
+
 Round 10 — block-paged pool + shared-prefix reuse: with a PAGED
 stepwise artifact (``export_generator(..., paged=True)``) the engine
 swaps the ``slots × T`` slab reservation for a shared pool of
@@ -533,24 +563,105 @@ class PrefixCache:
             self.pool.release(blocks)
 
 
+class NgramDrafter:
+    """Per-request self-drafting cache: prompt-lookup / n-gram
+    speculation (Saxena, "Prompt Lookup Decoding") over the request's
+    OWN context — prompt tokens plus everything it has generated.
+
+    The index maps every n-gram (n <= ``max_ngram``) ending at or
+    before the second-to-last position to its most recent start, so
+    :meth:`propose` finds the latest PRIOR occurrence of the current
+    suffix in O(max_ngram) dict probes and proposes the tokens that
+    followed it — repetitive text (code, templated prose, the
+    fixed-point loops untrained models collapse into) drafts itself.
+    No second model, no device work: the drafter is pure host-side
+    bookkeeping the scheduler thread owns with its slot."""
+
+    __slots__ = ("tokens", "max_ngram", "_index")
+
+    def __init__(self, tokens, max_ngram: int = 3):
+        if max_ngram < 1:
+            raise ValueError(f"max_ngram must be >= 1, got {max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.tokens: list[int] = []
+        self._index: dict[tuple[int, ...], int] = {}
+        for t in tokens:
+            self.extend(int(t))
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def extend(self, tok: int) -> None:
+        """Append one context token. Indexes the n-grams ending at the
+        PREVIOUS last position — the current suffix is never its own
+        lookup hit, so a proposal always continues a strictly prior
+        occurrence."""
+        self.tokens.append(int(tok))
+        end = len(self.tokens) - 1
+        for n in range(1, self.max_ngram + 1):
+            start = end - n
+            if start < 0:
+                break
+            self._index[tuple(self.tokens[start:end])] = start
+
+    def propose(self, k: int) -> list[int]:
+        """Up to ``k`` draft tokens: the continuation after the most
+        recent prior occurrence of the LONGEST matching suffix n-gram;
+        ``[]`` when no suffix of length <= max_ngram recurs (the slot
+        then falls back to the normal single-token step)."""
+        if k < 1 or len(self.tokens) < 2:
+            return []
+        for n in range(min(self.max_ngram, len(self.tokens) - 1), 0, -1):
+            start = self._index.get(tuple(self.tokens[-n:]))
+            if start is not None:
+                j = start + n
+                return self.tokens[j:j + k]
+        return []
+
+
 class RetryAfterEstimator:
     """Retry-After from MEASURED service rate: an EMA over decode-step
     wall times × the estimated steps until a slot frees (scaled by how
     many admission waves the queue ahead represents). Replaces the
     round-9 queue-depth linear guess, which knew nothing about how
-    fast steps actually drain."""
+    fast steps actually drain.
+
+    Speculative decoding breaks the one-dispatch-one-token identity a
+    remaining-token count silently assumed: a slot with T tokens to go
+    frees after ~T / (tokens-per-dispatch) dispatches, not T. The
+    estimator therefore also keeps a tokens-per-dispatch EMA (seeded
+    at the spec-off truth of exactly 1.0, fed the mean per-row advance
+    of every dispatch) and :meth:`dispatches_for` converts row-steps
+    to dispatches through it — with speculation off the divisor stays
+    exactly 1.0, so the pre-spec arithmetic is bitwise unchanged."""
 
     def __init__(self, alpha: float = 0.2):
         if not 0.0 < alpha <= 1.0:
             raise ValueError(f"alpha must be in (0, 1], got {alpha}")
         self.alpha = alpha
         self.ema_step_s: float | None = None
+        #: mean tokens one dispatch advances a live row by — exactly
+        #: 1.0 until a verify dispatch accepts a draft
+        self.ema_tokens_per_dispatch: float = 1.0
 
     def observe(self, step_s: float) -> None:
         if self.ema_step_s is None:
             self.ema_step_s = float(step_s)
         else:
             self.ema_step_s += self.alpha * (step_s - self.ema_step_s)
+
+    def observe_advance(self, mean_tokens: float) -> None:
+        """Feed one dispatch's mean per-row advance (1.0 for a normal
+        step; 1 + accepted/rows for a verify dispatch)."""
+        self.ema_tokens_per_dispatch += self.alpha * (
+            float(mean_tokens) - self.ema_tokens_per_dispatch)
+
+    def dispatches_for(self, row_steps: float) -> float:
+        """Remaining row-steps (forced + tokens to go) -> expected
+        DISPATCHES until they drain, through the measured
+        tokens-per-dispatch (clamped at 1.0 — a dispatch never
+        advances a row by less than one step)."""
+        return float(row_steps) / max(1.0, self.ema_tokens_per_dispatch)
 
     @property
     def seeded(self) -> bool:
@@ -628,6 +739,15 @@ class GenRequest:
     # perf_counter instant the scheduler enforces between steps
     deadline_ms: int = 0
     deadline_t: float = 0.0
+    # host-side stop sequences: generation retires the moment the
+    # emitted tokens end with any of these, the match itself truncated
+    # from the output (checked after EVERY accepted token, so the
+    # speculative path truncates at the same boundary)
+    stop_sequences: list[list[int]] = dataclasses.field(
+        default_factory=list)
+    # per-request speculative width: None = the engine's --spec_tokens
+    # default, 0 = off for this request, 2..engine width = a cap
+    spec_tokens: int | None = None
     future: Future = dataclasses.field(default_factory=Future)
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
     t_admit: float = 0.0            # popped from the queue (slot owned)
@@ -720,12 +840,26 @@ class _Slot:
         # the suffix forever (None = cold path inserted at prefill, or
         # exact hit whose entries already exist)
         self.pending_insert: np.ndarray | None = None
+        # ---- speculative decoding (round 16) ------------------------
+        #: tokens emitted so far (>= len(tokens): a matched stop
+        #: sequence truncates `tokens` but the emission happened)
+        self.emitted = 0
+        #: the per-request prompt-lookup drafter (None: spec off for
+        #: this request — sampled, or disabled by knob)
+        self.drafter: NgramDrafter | None = None
+        #: drafts riding the CURRENT verify dispatch (empty outside one)
+        self.draft: list[int] = []
+        #: accepted draft tokens over the request's lifetime (the
+        #: `spec_accepted` timings field)
+        self.spec_accepted = 0
 
     def remaining_steps(self) -> int:
-        """Steps until this slot retires at its max_new bound (EOS may
-        retire it sooner) — the Retry-After steps-to-free signal."""
+        """ROW-STEPS until this slot retires at its max_new bound (EOS
+        may retire it sooner) — the Retry-After steps-to-free signal;
+        the estimator converts row-steps to dispatches through its
+        tokens-per-dispatch EMA (1:1 without speculation)."""
         return len(self.forced) + max(1, self.req.max_new
-                                      - len(self.tokens))
+                                      - self.emitted)
 
 
 @scheduler_owned("_pool", "_live", "_free", "_admitting", "_tables",
@@ -751,7 +885,8 @@ class GenerationEngine:
                  metrics_logger=None, thread_sanitizer: bool = False,
                  default_deadline_ms: int = 0,
                  drain_timeout_s: float = 30.0,
-                 stall_after_s: float = 10.0):
+                 stall_after_s: float = 10.0,
+                 spec_tokens: int = 0):
         self.sw = stepwise
         m = stepwise.step_meta
         self.slots: int = int(m["slots"])
@@ -848,6 +983,28 @@ class GenerationEngine:
         self._g_drain_ms = reg.gauge(
             "serving_drain_ms",
             "wall-clock milliseconds the last graceful drain took")
+        # speculative-decoding observables (round 16): registered
+        # unconditionally so /stats//metrics keys are stable; all zero
+        # while spec_tokens=0
+        self._c_spec_proposed = reg.counter(
+            "serving_spec_proposed_total",
+            "draft tokens offered to verify dispatches by the "
+            "per-request prompt-lookup drafters")
+        self._c_spec_accepted = reg.counter(
+            "serving_spec_accepted_total",
+            "draft tokens accepted by the exact greedy rejection rule")
+        self._c_spec_emitted = reg.counter(
+            "serving_spec_emitted_total",
+            "tokens emitted by draft-carrying rows of verify "
+            "dispatches (accepted drafts + the correction/bonus token)")
+        self._c_verify_steps = reg.counter(
+            "serving_verify_steps_total",
+            "K-token speculative verify dispatches (the spec path's "
+            "analogue of serving_decode_steps_total)")
+        self._g_accept_rate = reg.gauge(
+            "serving_spec_accept_rate",
+            "accepted / proposed draft tokens over the engine's "
+            "lifetime (0 until any draft was offered)")
         self._g_queue_depth = reg.gauge(
             "serving_queue_depth", "requests waiting for admission")
         self._g_live_slots = reg.gauge(
@@ -875,6 +1032,36 @@ class GenerationEngine:
         # scheduler thread after each shared step — a plain float so
         # submit threads can read it without touching _live
         self._steps_to_free_hint: float = 1.0
+        # ---- speculative decoding (round 16) ------------------------
+        if spec_tokens < 0 or spec_tokens == 1:
+            raise ValueError(
+                f"spec_tokens must be 0 (off) or >= 2 (anchor + at "
+                f"least one draft lane per verify dispatch), got "
+                f"{spec_tokens}")
+        art_spec = int(getattr(stepwise, "spec_tokens", 0))
+        if spec_tokens:
+            if not getattr(stepwise, "paged", False):
+                raise ValueError(
+                    "spec_tokens needs a PAGED stepwise artifact "
+                    "(draft rejection rewinds per-row pos through the "
+                    "block tables) — re-export with paged=True")
+            if not art_spec:
+                raise ValueError(
+                    "spec_tokens > 0 but this artifact carries no "
+                    "verify program — re-export with export_generator("
+                    f"..., spec_tokens={spec_tokens}), or run with "
+                    "spec_tokens=0")
+            if spec_tokens > art_spec:
+                raise ValueError(
+                    f"spec_tokens {spec_tokens} exceeds this "
+                    f"artifact's exported verify width {art_spec} "
+                    "(spec_tokens in export.json) — re-export wider, "
+                    "or lower the knob")
+        #: requested speculative width (0 = off; <= the artifact's)
+        self.spec_tokens = int(spec_tokens)
+        #: the exported verify program's lane width (the dispatch
+        #: shape); 0 when speculation is off for this engine
+        self._verify_width = art_spec if spec_tokens else 0
         # ---- block-paged pool state (paged stepwise artifacts) ------
         self.paged: bool = bool(getattr(stepwise, "paged", False))
         self._c_tokens_saved = reg.counter(
@@ -1003,6 +1190,8 @@ class GenerationEngine:
                       top_k: int | None = None, top_p: float | None = None,
                       seed: int = 0, request_id: str | None = None,
                       deadline_ms: int | None = None,
+                      stop_sequences=None,
+                      spec_tokens: int | None = None,
                       eos_id: int | None = ...) -> GenRequest:
         """Validate client inputs into a :class:`GenRequest` — every
         check happens HERE, on the caller's thread, so nothing
@@ -1059,6 +1248,50 @@ class GenerationEngine:
         if deadline_ms:
             req.deadline_ms = int(deadline_ms)
             req.deadline_t = req.submitted_at + deadline_ms / 1e3
+        if stop_sequences is not None:
+            if not isinstance(stop_sequences, (list, tuple)):
+                raise ValueError(
+                    f"stop_sequences must be a list of token-id "
+                    f"sequences, got {type(stop_sequences).__name__}")
+            if len(stop_sequences) > 16:
+                raise ValueError(
+                    f"at most 16 stop_sequences per request, got "
+                    f"{len(stop_sequences)}")
+            clean: list[list[int]] = []
+            for i, ss in enumerate(stop_sequences):
+                if not isinstance(ss, (list, tuple)) or not ss:
+                    raise ValueError(
+                        f"stop_sequences[{i}] must be a non-empty list "
+                        f"of token ids, got {ss!r}")
+                if len(ss) > 64:
+                    raise ValueError(
+                        f"stop_sequences[{i}] has {len(ss)} tokens "
+                        "(bound: 64) — a stop sequence longer than any "
+                        "plausible generation is a client bug")
+                for t in ss:
+                    if isinstance(t, bool) or not isinstance(
+                            t, (int, np.integer)):
+                        raise ValueError(
+                            f"stop_sequences[{i}] holds a non-integer "
+                            f"token {t!r}")
+                clean.append([int(t) for t in ss])
+            req.stop_sequences = clean
+        if spec_tokens is not None:
+            if isinstance(spec_tokens, bool) or not isinstance(
+                    spec_tokens, (int, np.integer)) or spec_tokens < 0 \
+                    or spec_tokens == 1:
+                raise ValueError(
+                    f"spec_tokens must be 0 (off) or >= 2 per request, "
+                    f"got {spec_tokens!r}")
+            if spec_tokens > self.spec_tokens:
+                raise ValueError(
+                    f"spec_tokens {spec_tokens} exceeds this engine's "
+                    f"width {self.spec_tokens}"
+                    + ("" if self.spec_tokens else
+                       " (speculative decoding is off — start the "
+                       "server with --spec_tokens K over an artifact "
+                       "exported with a verify program)"))
+            req.spec_tokens = int(spec_tokens)
         return req
 
     def _enqueue(self, reqs: list[GenRequest]) -> list[Future]:
@@ -1525,6 +1758,17 @@ class GenerationEngine:
                 f"({type(err).__name__}: {err}); its neighbors were "
                 "not disturbed"))
 
+    def _drafter_for(self, req: GenRequest) -> NgramDrafter | None:
+        """The per-request drafter, or None when this request cannot
+        speculate: engine spec off, request opted out (spec_tokens=0),
+        or SAMPLED — the exact rejection rule is a greedy contract
+        (token == argmax); a sampled request always dispatches at lane
+        width 1 with its one-Gumbel-per-token host stream untouched."""
+        if not self._verify_width or req.temperature > 0.0 \
+                or req.spec_tokens == 0:
+            return None
+        return NgramDrafter([int(t) for t in req.prompt])
+
     @scheduler_thread
     def _admit_slab(self, req: GenRequest, index: int) -> None:
         ids = np.zeros((1, self.prompt_len), np.int32)
@@ -1590,6 +1834,7 @@ class GenerationEngine:
             self._admit_counter += 1
             slot = _Slot(req, index, pad=0, pos=start,
                          rng=req.sampler(), seq=self._admit_counter)
+            slot.drafter = self._drafter_for(req)
             slot.t_prefill_done = time.perf_counter()
             slot.last_tok = int(tokens[start])
             slot.forced = [int(t) for t in tokens[start + 1:]]
@@ -1663,6 +1908,7 @@ class GenerationEngine:
         self._admit_counter += 1
         slot = _Slot(req, index, pad=0, pos=p, rng=req.sampler(),
                      seq=self._admit_counter)
+        slot.drafter = self._drafter_for(req)
         slot.t_prefill_done = time.perf_counter()
         tok = self._pick(slot, logits0)
         self._emit(slot, tok)
@@ -1701,34 +1947,62 @@ class GenerationEngine:
         slot.req.future.set_exception(err)
 
     @scheduler_thread
-    def _ensure_write_block(self, slot: _Slot) -> None:
-        """Before a decode step writes at ``slot.pos``: allocate-on-
-        write when the target table entry is still the null block, and
-        copy-on-write when the target block is shared (prefix cache or
+    def _ensure_write_block(self, slot: _Slot, n: int = 1) -> None:
+        """Before a decode step writes at ``slot.pos`` (or a verify
+        dispatch writes the span ``pos..pos+n-1``): allocate-on-write
+        when a target table entry is still the null block, and
+        copy-on-write when a target block is shared (prefix cache or
         another slot still references it) — a divergence must never
-        mutate bytes someone else reads."""
-        bi = slot.pos // self.block_size
-        pb = int(self._tables[slot.index, bi])
-        if pb == 0:
-            if self.blocks.free_count < 1 \
-                    and self.prefix_cache is not None:
-                self.prefix_cache.evict(1)
-            self._tables[slot.index, bi] = self.blocks.alloc(1)[0]
-        elif self.blocks.refcount(pb) > 1:
-            # cow spans live on the scheduler lane (they interleave
-            # with the slot's long decode window, and slot lanes must
-            # stay non-overlapping); the request id keeps correlation
-            with span("cow_copy", lane="scheduler",
-                      request_id=slot.req.request_id,
-                      slot=slot.index, block=pb):
+        mutate bytes someone else reads. Only the FIRST block of a
+        verify span can be shared (anything past the slot's own write
+        frontier was never cached), but every block gets the same
+        check — the invariant, not the current topology, is what the
+        code states."""
+        bs = self.block_size
+        for bi in range(slot.pos // bs, (slot.pos + n - 1) // bs + 1):
+            pb = int(self._tables[slot.index, bi])
+            if pb == 0:
                 if self.blocks.free_count < 1 \
                         and self.prefix_cache is not None:
                     self.prefix_cache.evict(1)
-                nb = self.blocks.alloc(1)[0]
-                self._pool = self._copy_block(self._pool, pb, nb)
-                self._tables[slot.index, bi] = nb
+                self._tables[slot.index, bi] = self.blocks.alloc(1)[0]
+            elif self.blocks.refcount(pb) > 1:
+                # cow spans live on the scheduler lane (they interleave
+                # with the slot's long decode window, and slot lanes
+                # must stay non-overlapping); the request id keeps
+                # correlation
+                with span("cow_copy", lane="scheduler",
+                          request_id=slot.req.request_id,
+                          slot=slot.index, block=pb):
+                    if self.blocks.free_count < 1 \
+                            and self.prefix_cache is not None:
+                        self.prefix_cache.evict(1)
+                    nb = self.blocks.alloc(1)[0]
+                    self._pool = self._copy_block(self._pool, pb, nb)
+                    self._tables[slot.index, bi] = nb
+                    self.blocks.release([pb])
+                self._c_cow.inc()
+
+    @scheduler_thread
+    def _release_trailing_blocks(self, slot: _Slot,
+                                 span_end: int) -> None:
+        """After a draft rejection rewound ``slot.pos``: any block the
+        verify span secured PAST the next write position holds only
+        rejected-lane bytes nothing will ever read — its (fresh,
+        refcount-1) ref returns to the pool and the table entry goes
+        back to the null block. The block containing the next write
+        position is kept: the next dispatch writes into it. No-op when
+        the rejection stayed inside one block — the left-aligned paged
+        layout means a rewind releases nothing unless the span crossed
+        a block boundary."""
+        bs = self.block_size
+        row = self._tables[slot.index]
+        last = min(span_end // bs, row.size - 1)
+        for bi in range(slot.pos // bs + 1, last + 1):
+            pb = int(row[bi])
+            if pb:
                 self.blocks.release([pb])
-            self._c_cow.inc()
+                row[bi] = 0
 
     def _pick(self, slot: _Slot, logits: np.ndarray) -> int:
         """Per-request sampling on the host side of the step boundary
@@ -1745,17 +2019,34 @@ class GenerationEngine:
 
     @scheduler_thread
     def _emit(self, slot: _Slot, tok: int) -> None:
-        """Record one sampled token; retire or keep the slot live."""
+        """Record one sampled/accepted token; retire or keep the slot
+        live. Runs once per token in emission order on BOTH paths —
+        normal decode and the spec accept loop — so EOS, ``max_new``
+        and ``stop_sequences`` truncate at exactly the same boundary
+        with speculation on or off."""
+        slot.emitted += 1
         slot.tokens.append(tok)
         slot.last_tok = tok
         self._c_tokens_out.inc()
+        if slot.drafter is not None:
+            slot.drafter.extend(tok)
         req = slot.req
-        if len(slot.tokens) == 1:
+        if slot.emitted == 1:
             req.t_first = time.perf_counter()
-        done = (len(slot.tokens) >= req.max_new
+        stopped = False
+        for ss in req.stop_sequences:
+            n = len(ss)
+            if len(slot.tokens) >= n and slot.tokens[-n:] == ss:
+                # truncate AT the boundary: the match itself never
+                # reaches the client (checked after every token, so a
+                # match is always a suffix of the emitted stream)
+                del slot.tokens[-n:]
+                stopped = True
+                break
+        done = (stopped or slot.emitted >= req.max_new
                 or (req.eos_id is not None and tok == req.eos_id))
         if done:
-            # pad to max_new after EOS — byte-identical to the
+            # pad to max_new after EOS/stop — byte-identical to the
             # monolithic while_loop's preallocated pad_id buffer
             toks = slot.tokens + [req.pad_id] * (req.max_new
                                                  - len(slot.tokens))
@@ -1791,6 +2082,10 @@ class GenerationEngine:
                                * 1e3, 3),
             "total_ms": round((t_ret - req.submitted_at) * 1e3, 3),
             "tokens": len(slot.tokens),
+            # draft tokens the verify dispatches accepted for THIS
+            # request (0 with speculation off) — the per-request view
+            # of serving_spec_accepted_total
+            "spec_accepted": slot.spec_accepted,
         }
         with span("retire", lane=lane, request_id=req.request_id):
             if self.paged:
@@ -1839,18 +2134,56 @@ class GenerationEngine:
         return feats
 
     @scheduler_thread
-    def _dispatch_decode(self, feats: dict) -> np.ndarray | None:
-        """One shared decode dispatch under the bounded re-dispatch
-        protocol: a first failure that left the donated pool intact is
-        retried once (transient faults heal invisibly — same greedy
-        bytes, one extra dispatch); a REPEAT failure evicts the
-        newest-admitted slot (fails it loudly) and re-dispatches the
-        survivors, whose rows are computationally independent — their
-        greedy bytes match an undisturbed run. Bounded: at most one
-        retry plus one eviction per remaining live slot. Returns the
-        logits, or None when eviction emptied the batch. A
-        pool-consuming failure re-raises into the engine-fatal
-        handler."""
+    def _build_verify_feats(self) -> dict:
+        """The K-token verify dispatch's operand dict: lane 0 of every
+        live row is its anchor token (exactly what the normal step
+        would dispatch), lanes 1..len(draft) its draft proposals, and
+        ``n_tok`` gates the write span per row — draftless, sampled and
+        teacher-forced slots ride the same dispatch at width 1.
+        Rebuilt after a quarantine eviction, same as
+        :meth:`_build_step_feats` (surviving rows keep their drafts)."""
+        kk = self._verify_width
+        tok = np.zeros((self.slots, kk), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        pad = np.zeros((self.slots,), np.int32)
+        alive = np.zeros((self.slots,), np.int32)
+        n_tok = np.ones((self.slots,), np.int32)
+        for i, s in self._live.items():
+            tok[i, 0] = s.last_tok
+            if s.draft:
+                tok[i, 1:1 + len(s.draft)] = s.draft
+                n_tok[i] = 1 + len(s.draft)
+            pos[i] = s.pos
+            pad[i] = s.pad
+            alive[i] = 1
+        return {"tok": tok, "pos": pos, "pad": pad, "alive": alive,
+                "n_tok": n_tok, "block_tables": self._tables,
+                **self._pool}
+
+    @scheduler_thread
+    def _dispatch_decode(self, feats: dict, *, call=None,
+                         rebuild=None,
+                         span_name: str = "decode_step"
+                         ) -> np.ndarray | None:
+        """One shared dispatch (normal decode step, or — ``call``/
+        ``rebuild`` overridden — the K-token verify program) under the
+        bounded re-dispatch protocol: a first failure that left the
+        donated pool intact is retried once (transient faults heal
+        invisibly — same greedy bytes, one extra dispatch); a REPEAT
+        failure evicts the newest-admitted slot (fails it loudly) and
+        re-dispatches the survivors, whose rows are computationally
+        independent — their greedy bytes match an undisturbed run.
+        Bounded: at most one retry plus one eviction per remaining
+        live slot. Returns the logits, or None when eviction emptied
+        the batch. A pool-consuming failure re-raises into the
+        engine-fatal handler. Both programs share ONE protocol and ONE
+        ``engine.decode_step`` fault seam — a verify dispatch is
+        quarantined exactly like a normal one (eviction releases the
+        victim's whole span; survivors' drafts ride the rebuild)."""
+        if call is None:
+            call = self.sw.decode
+        if rebuild is None:
+            rebuild = self._build_step_feats
         reg = faults.active()
         idx = reg.next_index("engine.decode_step") \
             if reg is not None else None
@@ -1863,9 +2196,9 @@ class GenerationEngine:
                     # rules stay one-shot transients, p-rules resample
                     reg.raise_if_armed("engine.decode_step", index=idx,
                                        attempt=attempt)
-                with span("decode_step", lane="scheduler",
+                with span(span_name, lane="scheduler",
                           slots=int(feats["alive"].sum())):
-                    out = self.sw.decode(feats)
+                    out = call(feats)
                     # blocks on the result BEFORE adopting the returned
                     # pool: an async device fault surfaces here, and
                     # self._pool must still name the donated (deleted)
@@ -1882,16 +2215,16 @@ class GenerationEngine:
                     raise          # donated pool consumed: engine-fatal
                 attempt += 1
                 if attempt == 1:
-                    log.warning("shared decode step failed (%s) — "
-                                "re-dispatching once", e)
+                    log.warning("shared %s failed (%s) — "
+                                "re-dispatching once", span_name, e)
                     self._c_redispatches.inc()
                     continue
                 victim = max(self._live.values(),
                              key=lambda s: s.admit_seq)
-                log.warning("shared decode step failed twice — "
+                log.warning("shared %s failed twice — "
                             "evicting newest-admitted request %s and "
                             "re-dispatching %d survivor(s): %s",
-                            victim.req.request_id,
+                            span_name, victim.req.request_id,
                             len(self._live) - 1, e)
                 self._fail_slot(victim, PoisonedRequestError(
                     f"request {victim.req.request_id} evicted after "
@@ -1900,20 +2233,62 @@ class GenerationEngine:
                     "re-dispatched undisturbed"))
                 if not self._live:
                     return None
-                feats = self._build_step_feats()
+                feats = rebuild()
                 self._c_redispatches.inc()
 
     @scheduler_thread
+    def _propose_drafts(self) -> None:
+        """Ask each eligible live slot's drafter for up to
+        ``spec_tokens - 1`` draft tokens (request-level ``spec_tokens``
+        caps lower), stashing them on ``slot.draft``. Ineligible:
+        sampled/opted-out slots (no drafter), teacher-forced slots
+        (their next tokens are KNOWN — forcing is already free of
+        sampling), slots one token from ``max_new`` (nothing to win),
+        and slots with a pending prefix-cache insert (the insert must
+        observe a prompt-pure tail block). NOT the verify-dispatch
+        trigger: block securing may still DROP a slot's drafts under
+        pressure, so :meth:`_shared_step` re-derives the trigger from
+        the surviving ``slot.draft`` lists afterwards."""
+        capacity = self.blocks_per_slot * self.block_size
+        for s in self._live.values():
+            s.draft = []
+            if s.drafter is None or s.forced \
+                    or s.pending_insert is not None:
+                continue
+            width = (self.spec_tokens if s.req.spec_tokens is None
+                     else min(s.req.spec_tokens, self.spec_tokens))
+            k = min(width - 1,
+                    s.req.max_new - s.emitted - 1,
+                    capacity - 1 - s.pos)
+            if k < 1:
+                continue
+            s.draft = s.drafter.propose(k)
+
+    @scheduler_thread
     def _shared_step(self) -> None:
-        """ONE batched decode step for every live slot."""
+        """ONE batched dispatch for every live slot: the single-token
+        decode step, or — when speculation is on and any slot drafted —
+        the K-token verify program (draftless slots ride at width 1)."""
         if self.paged:
-            # secure every live row's write target first: allocate-on-
+            if self._verify_width:
+                self._propose_drafts()
+            # secure every live row's write span first: allocate-on-
             # write at block boundaries, copy-on-write on shared blocks.
             # A row that cannot get a block fails ALONE — its neighbors
-            # still step.
+            # still step; a SPEC row that cannot get its draft span
+            # drops the drafts first (degrading to the normal step is
+            # strictly better than dying for an optimization).
             for s in list(self._live.values()):
                 try:
-                    self._ensure_write_block(s)
+                    try:
+                        self._ensure_write_block(s, 1 + len(s.draft))
+                    except BlocksExhaustedError:
+                        if not s.draft:
+                            raise
+                        span_end = s.pos + len(s.draft)
+                        s.draft = []
+                        self._release_trailing_blocks(s, span_end)
+                        self._ensure_write_block(s, 1)
                 except BlocksExhaustedError as e:
                     self._fail_slot(s, BlocksExhaustedError(
                         f"out of cache blocks mid-decode after "
@@ -1930,18 +2305,35 @@ class GenerationEngine:
                         f"({type(e).__name__}: {e})"))
             if not self._live:
                 return
-        feats = self._build_step_feats()
-        t0 = time.perf_counter()
-        logits = self._dispatch_decode(feats)
+        use_verify = any(s.draft for s in self._live.values())
+        if use_verify:
+            self._c_spec_proposed.inc(
+                sum(len(s.draft) for s in self._live.values()))
+            feats = self._build_verify_feats()
+            t0 = time.perf_counter()
+            logits = self._dispatch_decode(
+                feats, call=self.sw.verify,
+                rebuild=self._build_verify_feats,
+                span_name="verify_step")
+        else:
+            feats = self._build_step_feats()
+            t0 = time.perf_counter()
+            logits = self._dispatch_decode(feats)
         if logits is None:
             return
         self._retry.observe(time.perf_counter() - t0)
         with self.registry.atomic():
-            self._c_decode_steps.inc()
-            self._c_decode_slot_steps.inc(len(self._live))
+            if use_verify:
+                self._c_verify_steps.inc()
+            else:
+                self._c_decode_steps.inc()
+                self._c_decode_slot_steps.inc(len(self._live))
+        advance = rows = 0
         for i, s in list(self._live.items()):
-            s.pos += 1
+            rows += 1
             if s.forced:
+                s.pos += 1
+                advance += 1
                 # teacher-forced prompt suffix: the next token is
                 # already known — this step's logits are scaffolding
                 s.last_tok = s.forced.pop(0)
@@ -1954,17 +2346,70 @@ class GenerationEngine:
                 # blocks: cache it. Inserting shares the tail block,
                 # so this slot's NEXT write copy-on-writes it — the
                 # cached bytes stay pure, same as the cold path.
+                # (_propose_drafts never drafts under a pending
+                # insert, so the shared tail holds prompt bytes only.)
                 tokens = s.pending_insert
                 nb = -(-int(tokens.size) // self.block_size)
                 self.prefix_cache.insert(
                     tokens, [int(b) for b in self._tables[s.index, :nb]])
                 s.pending_insert = None
-            nxt = self._pick(s, logits[i])
+            row_logits = logits[i]          # [V], or [K, V] on verify
+            if s.draft:
+                # exact greedy rejection: accept the longest draft
+                # prefix matching the argmax chain, then ONE more token
+                # — the correction at the first mismatch, or the bonus
+                # from the last lane when every draft held. Emitted in
+                # order through _emit, so EOS / stop_sequences / max_new
+                # cut the stream at exactly the non-speculative
+                # boundary.
+                drafts, s.draft = s.draft, []
+                emitted, acc = [], 0
+                for j, d in enumerate(drafts):
+                    a = int(np.argmax(row_logits[j]))
+                    if a != d:
+                        emitted.append(a)
+                        break
+                    emitted.append(d)
+                    acc += 1
+                else:
+                    emitted.append(int(np.argmax(row_logits[
+                        len(drafts)])))
+                span_end = s.pos + len(drafts)
+                s.pos += acc + 1            # the rejection rewind
+                advance += acc + 1
+                s.spec_accepted += acc
+                self._c_spec_accepted.inc(acc)
+                # _emit re-adds a still-live slot to _live and expects
+                # the caller to have removed it first — so the slot is
+                # popped before EVERY emission, not just the first
+                # (leaving it mounted across a mid-run retirement
+                # would double-retire it next step)
+                retired = False
+                n_emitted = 0
+                for tok in emitted:
+                    del self._live[i]
+                    self._emit(s, tok)
+                    n_emitted += 1
+                    retired = s.index not in self._live
+                    if retired:
+                        break               # EOS / stop / max_new
+                self._c_spec_emitted.inc(n_emitted)
+                if not retired:
+                    self._release_trailing_blocks(s, span_end)
+                continue
+            s.pos += 1
+            advance += 1
+            nxt = self._pick(s, row_logits[0] if use_verify
+                             else row_logits)
             del self._live[i]           # _emit re-adds if still live
             self._emit(s, nxt)
+        if rows:
+            self._retry.observe_advance(advance / rows)
         live = list(self._live.values())
         self._steps_to_free_hint = (
-            min(s.remaining_steps() for s in live) if live else 1.0)
+            self._retry.dispatches_for(
+                min(s.remaining_steps() for s in live)) if live
+            else 1.0)
 
     # ---- observability ----------------------------------------------
     @snapshot_view
@@ -1977,6 +2422,11 @@ class GenerationEngine:
         with self._cond:
             self._g_queue_depth.set(len(self._queue))
             self._g_live_slots.set(len(self._live))
+        with self.registry.atomic():
+            proposed = self._c_spec_proposed.value
+            self._g_accept_rate.set(
+                round(self._c_spec_accepted.value / proposed, 4)
+                if proposed else 0.0)
         if self.paged:
             with self.registry.atomic():
                 free = self.blocks.free_count
@@ -2021,6 +2471,15 @@ class GenerationEngine:
             "redispatches": c("serving_redispatches_total"),
             "drain_ms": c("serving_drain_ms"),
             "tokens_out": c("serving_tokens_out_total"),
+            # speculative decoding (zeros while spec_tokens=0): the
+            # accept_rate here and the /metrics gauge read the same
+            # snapshot, so they can never disagree
+            "spec_tokens": self.spec_tokens,
+            "verify_steps": c("serving_verify_steps_total"),
+            "spec_proposed": c("serving_spec_proposed_total"),
+            "spec_accepted": c("serving_spec_accepted_total"),
+            "spec_emitted": c("serving_spec_emitted_total"),
+            "accept_rate": c("serving_spec_accept_rate"),
             "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
             "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),

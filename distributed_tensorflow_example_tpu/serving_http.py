@@ -99,6 +99,19 @@ contract —
   default) covers the request-read path for the serving chaos soak
   (``experiments/serving_chaos.py``).
 
+Speculative decoding (round 16): ``--spec_tokens K`` arms the engine's
+self-drafting draft-and-verify loop over artifacts exported with a
+verify program (``export_generator(..., spec_tokens=K)``); an artifact
+WITHOUT one auto-falls back to spec-off with a logged warning instead
+of refusing to serve (the knob is an optimization, not a contract).
+Per-request payload knobs: ``spec_tokens`` (0 opts a request out, or a
+lower cap), and ``stop_sequences`` (a list of token-id sequences —
+generation retires the moment the output ends with any of them, the
+match truncated from the response; works with speculation on or off at
+identical boundaries). ``/stats`` and ``/metrics`` carry
+``accept_rate`` and the ``serving_spec_*`` counters; each response's
+``timings`` rows carry ``spec_accepted``.
+
 Fleet (round 15): N of these servers sit behind
 :class:`~.serving_router.ReplicaRouter` — ``/healthz`` (live/stalled/
 draining) drives the router's replica state machine, ``POST
@@ -155,7 +168,8 @@ class PredictServer:
                  thread_sanitizer: bool = False,
                  default_deadline_ms: int = 0,
                  drain_timeout_s: float = 30.0,
-                 stall_after_s: float = 10.0):
+                 stall_after_s: float = 10.0,
+                 spec_tokens: int = 0):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
@@ -225,14 +239,36 @@ class PredictServer:
                         "export_generator(..., stepwise=True), or serve "
                         "with scheduler='off'")
                 from .serving import load_stepwise
+                sw = load_stepwise(export_dir)
+                if spec_tokens and not sw.spec_tokens:
+                    # auto-off: the knob asks for an optimization this
+                    # artifact cannot run — serve without it (loudly)
+                    # rather than refuse traffic
+                    from .utils.logging import get_logger
+                    get_logger("serving").warning(
+                        "--spec_tokens %d requested but %r carries no "
+                        "verify program (exported without spec_tokens) "
+                        "— speculative decoding disabled for this "
+                        "server; re-export with export_generator(..., "
+                        "spec_tokens=K) to enable it", spec_tokens,
+                        export_dir)
+                    spec_tokens = 0
+                elif spec_tokens > sw.spec_tokens:
+                    from .utils.logging import get_logger
+                    get_logger("serving").warning(
+                        "--spec_tokens %d exceeds this artifact's "
+                        "exported verify width %d — clamping to %d",
+                        spec_tokens, sw.spec_tokens, sw.spec_tokens)
+                    spec_tokens = sw.spec_tokens
                 self.engine = GenerationEngine(
-                    load_stepwise(export_dir), max_queue=max_queue,
+                    sw, max_queue=max_queue,
                     prefix_cache=prefix_cache, registry=self.registry,
                     metrics_logger=self._request_logger,
                     thread_sanitizer=thread_sanitizer,
                     default_deadline_ms=default_deadline_ms,
                     drain_timeout_s=drain_timeout_s,
-                    stall_after_s=stall_after_s).start()
+                    stall_after_s=stall_after_s,
+                    spec_tokens=spec_tokens).start()
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
@@ -464,7 +500,17 @@ class PredictServer:
               # per-request latency budget (ms; engine default applies
               # when absent) — expiry retires the slot between steps
               # and answers 504
-              "deadline_ms": knob("deadline_ms", int)}
+              "deadline_ms": knob("deadline_ms", int),
+              # per-request speculative width: 0 opts this request out
+              # of drafting, 2..--spec_tokens caps it (absent = the
+              # server default; >0 on a spec-off server is a 400)
+              "spec_tokens": knob("spec_tokens", int)}
+        stop = payload.get("stop_sequences")
+        if stop is not None:
+            # shape/type validation happens in the engine's
+            # _make_request (on this handler thread), so a bad list is
+            # a clean 400 naming the offending row
+            kw["stop_sequences"] = stop
         seed = payload.get("seed", 0)
         if isinstance(seed, bool) or not isinstance(seed, int):
             raise ValueError(f"'seed' must be an integer, got {seed!r}")
@@ -535,6 +581,17 @@ class PredictServer:
                 "(export with export_generator for a decode artifact)")
         if self.engine is not None:
             return self._generate_scheduled(payload, request_id)
+        # engine-only payload knobs must not be silently ignored: the
+        # monolithic program cannot truncate on stop_sequences or
+        # speculate, and a 200 that quietly dropped the contract is
+        # worse than a clear 400
+        for knob in ("stop_sequences", "spec_tokens"):
+            if payload.get(knob) is not None:
+                raise ValueError(
+                    f"{knob!r} requires the continuous-batching "
+                    "scheduler (this server runs scheduler='off'; the "
+                    "monolithic decode program cannot honor it) — "
+                    "serve stepwise artifacts with scheduler on/auto")
         self._check_prompt_lengths(payload)
         sig = {k: v for k, v in self.servable.input_signature.items()
                if k != "rng"}
@@ -912,6 +969,15 @@ def main(argv=None) -> int:
                     "admissions 503 while queued/in-flight requests "
                     "finish; a scheduler thread still running past the "
                     "budget raises EngineStalledError")
+    ap.add_argument("--spec_tokens", type=int, default=0,
+                    help="speculative decoding: verify up to K-1 "
+                    "self-drafted tokens per shared dispatch (needs an "
+                    "artifact exported with export_generator(..., "
+                    "spec_tokens=K); auto-off with a warning when the "
+                    "artifact lacks the verify program). Greedy output "
+                    "stays byte-identical; 0 = off (bitwise no-op). "
+                    "Per-request `spec_tokens` in the payload opts out "
+                    "(0) or caps lower")
     ap.add_argument("--stall_after_s", type=float, default=10.0,
                     help="GET /healthz reports 'stalled' (503) once the "
                     "scheduler heartbeat is older than this")
@@ -939,7 +1005,8 @@ def main(argv=None) -> int:
                         thread_sanitizer=args.thread_sanitizer,
                         default_deadline_ms=args.default_deadline_ms,
                         drain_timeout_s=args.drain_timeout_s,
-                        stall_after_s=args.stall_after_s)
+                        stall_after_s=args.stall_after_s,
+                        spec_tokens=args.spec_tokens)
 
     def _graceful(signum, frame):
         # stop() must run off the serve_forever thread (shutdown()

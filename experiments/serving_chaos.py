@@ -44,6 +44,16 @@ round-14 contract:
                          honor 429/Retry-After semantics in a retry
                          loop: every request eventually lands, bytes
                          to parity.
+- ``spec_verify_fault``— a seeded ``engine.decode_step`` fault lands
+                         DURING a K-token speculative verify dispatch
+                         (round 16): the transient heals via the same
+                         bounded re-dispatch protocol (byte parity,
+                         exactly one extra dispatch, zero failures);
+                         a repeat failure at the same dispatch evicts
+                         the newest-admitted request with survivors
+                         byte-identical and every per-row ``pos``
+                         rewound exactly (pinned by byte parity plus
+                         exact ``blocks_free`` recovery).
 
 Usage::
 
@@ -448,6 +458,104 @@ def scenario_queue_full_retry(d: str, seed: int, vocab: int):
         eng.close()
 
 
+def scenario_spec_verify_fault(d: str, seed: int, vocab: int):
+    """Round-16 coverage: the decode-step fault seam fires DURING a
+    speculative verify dispatch. Builds its own verify-program export
+    (the shared scenario artifact carries none) over a repetitive
+    workload so verify dispatches genuinely happen, locates the first
+    one via a seeded instrumented run (everything is deterministic, so
+    the same global dispatch index is a verify dispatch in every
+    re-run), then asserts the PR-10 protocol end-to-end on that exact
+    dispatch."""
+    from serving_load import build_export
+    from distributed_tensorflow_example_tpu.serving_batch import \
+        PoisonedRequestError
+    rs = np.random.RandomState(seed + 7)
+    pattern = rs.randint(0, vocab, (3,)).astype(np.int32)
+    prompts = [np.tile(pattern, 3)[:int(rs.randint(4, PROMPT_LEN + 1))]
+               .astype(np.int32) for _ in range(3)]
+    with tempfile.TemporaryDirectory() as ds:
+        # max_new=10 (not the module MAX_NEW): the scenario's requests
+        # cap at 10 tokens, and the smaller monolithic scan keeps this
+        # tier-1 smoke's export cheap
+        build_export(ds, prompt_len=PROMPT_LEN, max_new=10,
+                     slots=SLOTS, seed=seed, paged=True,
+                     block_size=BLOCK,
+                     num_blocks=1 + 4 * SLOTS * _bps(), spec_tokens=4)
+
+        def run(spec: int, wrap: bool = False):
+            eng = fresh_engine(ds, spec_tokens=spec)
+            order: list[str] = []
+            if wrap:
+                od, ov = eng.sw.decode, eng.sw.verify
+                eng.sw.decode = \
+                    lambda f: (order.append("decode"), od(f))[1]
+                eng.sw.verify = \
+                    lambda f: (order.append("verify"), ov(f))[1]
+            try:
+                free0 = eng.stats()["blocks_free"]
+                handles = [eng.submit(p, max_new=10) for p in prompts]
+                outs: list = []
+                poisoned: list[str] = []
+                for h in handles:
+                    try:
+                        outs.append(h.result(timeout=120))
+                    except PoisonedRequestError:
+                        outs.append(None)
+                        poisoned.append(h.request_id)
+                _wait(lambda: eng.stats()["blocks_free"] == free0,
+                      what="exact blocks_free recovery")
+                return outs, poisoned, counters(eng), eng.stats(), order
+            finally:
+                eng.close()
+
+        ref, p0, _, _, _ = run(0)
+        und, p1, _, s1, order = run(4, wrap=True)
+        assert not p0 and not p1
+        assert und == ref, \
+            "undisturbed spec run diverged from the spec-off oracle"
+        assert s1["spec_accepted"] > 0, s1
+        assert "verify" in order, \
+            "the repetitive workload never dispatched a verify step"
+        v_idx = order.index("verify") + 1     # 1-based seam index
+        # transient: one fault at exactly that verify dispatch — the
+        # bounded re-dispatch heals it invisibly
+        faults.install(faults.parse_spec(
+            f"engine.decode_step:step={v_idx}", seed=seed))
+        try:
+            outs_t, pois_t, met_t, st_t, _ = run(4)
+        finally:
+            faults.install(None)
+        assert not pois_t and outs_t == ref, \
+            "transient verify fault was not healed to byte parity"
+        assert met_t["serving_redispatches_total"] == 1, met_t
+        assert met_t["serving_requests_failed_total"] == 0, met_t
+        assert st_t["verify_steps"] > 0, st_t
+        # repeat failure at the SAME verify dispatch: newest-admitted
+        # evicted, survivors byte-identical, per-row pos rewound
+        # exactly (byte parity + the exact blocks_free recovery inside
+        # run() are the rewind's observables)
+        faults.install(faults.parse_spec(
+            f"engine.decode_step:step={v_idx};"
+            f"engine.decode_step:step={v_idx}", seed=seed))
+        try:
+            outs_p, pois_p, met_p, _, _ = run(4)
+        finally:
+            faults.install(None)
+        assert len(pois_p) == 1, \
+            f"expected exactly one eviction, got {pois_p}"
+        survivors = [(i, o) for i, o in enumerate(outs_p)
+                     if o is not None]
+        assert all(o == ref[i] for i, o in survivors), \
+            "a survivor diverged after the verify-dispatch eviction"
+        assert met_p["serving_requests_failed_total"] == 1, met_p
+        assert met_p["serving_redispatches_total"] >= 2, met_p
+    return (f"verify dispatch {v_idx}: transient healed to byte parity "
+            f"(1 re-dispatch, 0 failures); repeat fault evicted "
+            f"{pois_p[0]} with {len(survivors)} survivors to parity "
+            "and exact pos/blocks recovery", met_p)
+
+
 SCENARIOS = {
     "deadline_storm": scenario_deadline_storm,
     "poison_step": scenario_poison_step,
@@ -456,6 +564,7 @@ SCENARIOS = {
     "flaky_dispatch": scenario_flaky_dispatch,
     "watchdog_trip": scenario_watchdog_trip,
     "queue_full_retry": scenario_queue_full_retry,
+    "spec_verify_fault": scenario_spec_verify_fault,
 }
 
 #: scenarios that need the deliberately under-provisioned block pool

@@ -177,7 +177,8 @@ def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                  platforms=("cpu",), paged: bool = False,
                  block_size: int = 16, num_blocks=None,
                  weight_quant: str = "off",
-                 kv_cache_dtype: str = "auto", pool_bytes=None):
+                 kv_cache_dtype: str = "auto", pool_bytes=None,
+                 spec_tokens: int = 0):
     """Seeded GPT stepwise export (ragged monolithic artifact too, so
     the off path serves the same mixed prompt lengths). ``platforms``
     includes "tpu" when bench.py runs the serving row on chip;
@@ -197,7 +198,7 @@ def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                      block_size=block_size, num_blocks=num_blocks,
                      weight_quant=weight_quant,
                      kv_cache_dtype=kv_cache_dtype,
-                     pool_bytes=pool_bytes,
+                     pool_bytes=pool_bytes, spec_tokens=spec_tokens,
                      platforms=tuple(platforms))
     return model.cfg.vocab_size
 
@@ -246,10 +247,34 @@ def make_requests(clients: int, requests: int, *, prompt_len: int,
     return matrix
 
 
+def make_repetitive_requests(clients: int, requests: int, *,
+                             prompt_len: int, max_new: int, vocab: int,
+                             seed: int, period: int = 3):
+    """The speculative-decoding workload: every prompt is one seeded
+    ``period``-token pattern tiled to a seeded length, so the
+    prompt-lookup drafter's suffix n-grams recur from token one — and
+    greedy decode of a fixed model drifts into its own repetitive
+    fixed points, which the drafter then mines from the GENERATED
+    context too. Same [client][request] -> (prompt, max_new) shape as
+    :func:`make_requests`."""
+    rs = np.random.RandomState(seed)
+    pattern = rs.randint(0, vocab, (period,)).astype(np.int32)
+    matrix = []
+    for _ in range(clients):
+        rows = []
+        for _ in range(requests):
+            p = int(rs.randint(max(2, period), prompt_len + 1))
+            prompt = np.tile(pattern, -(-p // period))[:p]
+            rows.append((prompt, max_new))
+        matrix.append(rows)
+    return matrix
+
+
 def run_mode(export_dir: str, matrix, *, scheduler: str,
              prompt_len: int, mode_name: str | None = None,
              prefix_cache: bool = True, trace: bool = False,
-             thread_sanitizer: bool = False) -> dict:
+             thread_sanitizer: bool = False,
+             spec_tokens: int = 0) -> dict:
     """Drive one server mode with the closed-loop client matrix;
     returns the result row (and stashes per-request generations under
     ``_gens`` for the parity check). ``thread_sanitizer=True`` arms the
@@ -267,7 +292,8 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     errors: list[str] = []
     with PredictServer(export_dir, scheduler=scheduler,
                        prefix_cache=prefix_cache,
-                       thread_sanitizer=thread_sanitizer) as srv:
+                       thread_sanitizer=thread_sanitizer,
+                       spec_tokens=spec_tokens) as srv:
         def client(ci):
             for prompt, m in matrix[ci]:
                 if scheduler == "on":
@@ -379,6 +405,17 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
             "prefill_tokens_saved": g["prefill_tokens_saved"],
             "blocks_total": g["blocks_total"],
             "cow_copies": g["cow_copies"],
+        })
+    if g.get("spec_tokens"):
+        # speculative-decoding observability: the accept-rate story
+        # and the dispatch-count win live on the row itself
+        row.update({
+            "spec_tokens": g["spec_tokens"],
+            "verify_steps": g["verify_steps"],
+            "spec_proposed": g["spec_proposed"],
+            "spec_accepted": g["spec_accepted"],
+            "spec_emitted": g["spec_emitted"],
+            "accept_rate": g["accept_rate"],
         })
     return row
 
@@ -588,6 +625,12 @@ def main(argv=None) -> int:
                     "model dtype (the bitwise no-op), 'int8' stores "
                     "quantized blocks + per-row scales (requires "
                     "--paged)")
+    ap.add_argument("--spec_tokens", type=int, default=0,
+                    help="speculative decoding: export the K-token "
+                    "verify program and serve the scheduler-on leg "
+                    "with --spec_tokens K (greedy byte parity vs the "
+                    "off leg still asserted — speculation is exact); "
+                    "needs --paged. --smoke runs its own spec legs")
     ap.add_argument("--prefix_mode", choices=("cold", "shared"),
                     default="cold",
                     help="workload shape: 'shared' prepends one seeded "
@@ -648,6 +691,18 @@ def main(argv=None) -> int:
     if args.router < 0:
         ap.error(f"--router takes a replica count >= 0, got "
                  f"{args.router}")
+    if args.spec_tokens:
+        if args.smoke:
+            ap.error("--smoke already runs its own spec_on/spec_off "
+                     "legs (repetitive workload, accept-rate and "
+                     "dispatch-win assertions) — drop --spec_tokens, "
+                     "or run a full-matrix spec leg without --smoke")
+        if not args.paged:
+            ap.error("--spec_tokens exports the verify program over "
+                     "the block-paged stepwise pair — add --paged")
+        if args.spec_tokens < 2:
+            ap.error(f"--spec_tokens must be >= 2 (anchor + at least "
+                     f"one draft lane), got {args.spec_tokens}")
     if args.smoke:
         args.clients, args.requests = 2, 2
         args.slots, args.prompt_len, args.max_new = 2, 8, 4
@@ -676,7 +731,9 @@ def main(argv=None) -> int:
                              num_blocks=None if quant
                              else args.num_blocks,
                              pool_bytes=None if quant
-                             else args.pool_bytes)
+                             else args.pool_bytes,
+                             spec_tokens=(0 if quant
+                                          else args.spec_tokens))
         matrix = matrix_for(vocab, args.prefix_mode)
         # the exported dir always holds the monolithic artifact too,
         # so scheduler=off is the oracle for slab AND paged runs
@@ -689,19 +746,23 @@ def main(argv=None) -> int:
                              num_blocks=args.num_blocks,
                              pool_bytes=args.pool_bytes,
                              weight_quant=args.weight_quant,
-                             kv_cache_dtype=args.kv_cache_dtype)
+                             kv_cache_dtype=args.kv_cache_dtype,
+                             spec_tokens=args.spec_tokens)
                 rows = [run_mode(dq, matrix, scheduler="on",
                                  prompt_len=args.prompt_len,
                                  mode_name="int8_on",
-                                 thread_sanitizer=args.thread_sanitizer)]
+                                 thread_sanitizer=args.thread_sanitizer,
+                                 spec_tokens=args.spec_tokens)]
             rows.append(run_mode(d, matrix, scheduler="off",
                                  prompt_len=args.prompt_len))
         else:
             rows = [run_mode(d, matrix, scheduler="on",
                              prompt_len=args.prompt_len,
-                             mode_name=("paged_on" if args.paged
+                             mode_name=("spec_on" if args.spec_tokens
+                                        else "paged_on" if args.paged
                                         else "scheduler_on"),
-                             thread_sanitizer=args.thread_sanitizer),
+                             thread_sanitizer=args.thread_sanitizer,
+                             spec_tokens=args.spec_tokens),
                     run_mode(d, matrix, scheduler="off",
                              prompt_len=args.prompt_len)]
         if args.smoke:
@@ -794,12 +855,45 @@ def main(argv=None) -> int:
                                      mode_name="chaos_on")
             finally:
                 _faults.install(None)
+            # spec legs (round 16): self-drafting speculative decoding
+            # on a REPETITIVE workload (the drafter's food) against a
+            # verify-program export — byte parity vs the spec-off
+            # oracle over the same export, accept_rate > 0, strictly
+            # fewer verify dispatches than emitted tokens, and a real
+            # dispatch-count win (the emitted-tokens-per-dispatch > 1
+            # acceptance gate). max_new is raised so greedy decode has
+            # room to settle into the repetitive fixed points the
+            # drafter mines.
+            spec_max_new = max(args.max_new, 12)
+            spec_k = 4
+            with tempfile.TemporaryDirectory() as dsp:
+                build_export(dsp, prompt_len=args.prompt_len,
+                             max_new=spec_max_new, slots=args.slots,
+                             seed=args.seed, paged=True,
+                             block_size=args.block_size,
+                             num_blocks=1 + 4 * args.slots
+                             * -(-(args.prompt_len + spec_max_new)
+                                 // args.block_size),
+                             spec_tokens=spec_k)
+                rep = make_repetitive_requests(
+                    args.clients, args.requests,
+                    prompt_len=args.prompt_len, max_new=spec_max_new,
+                    vocab=vocab, seed=args.seed)
+                spec_off_row = run_mode(dsp, rep, scheduler="on",
+                                        prompt_len=args.prompt_len,
+                                        mode_name="spec_off")
+                spec_row = run_mode(dsp, rep, scheduler="on",
+                                    prompt_len=args.prompt_len,
+                                    mode_name="spec_on",
+                                    spec_tokens=spec_k)
+            sreg = spec_row["registry"]
             # router leg (round 15): the same matrix through a
             # 2-replica fleet — greedy bytes must not depend on which
             # replica serves (or on the router being in the path)
             router_row = run_router_mode(d, matrix, replicas=2)
             rows += [paged_cold, paged_shared, shared_off, int8_row,
-                     tsan_row, chaos_row, router_row]
+                     tsan_row, chaos_row, spec_off_row, spec_row,
+                     router_row]
             checks += [
                 ("router_parity_with_single_replica",
                  router_row["_gens"] == rows[0]["_gens"]),
@@ -836,6 +930,28 @@ def main(argv=None) -> int:
                 ("chaos_zero_failed_requests",
                  chaos_row["registry"].get(
                      "serving_requests_failed_total") == 0),
+                # round-16 spec gates: exactness, a real accept rate,
+                # and the dispatch-count win speculation exists for
+                ("spec_parity_with_off",
+                 spec_row["_gens"] == spec_off_row["_gens"]),
+                ("spec_accept_rate_positive",
+                 sreg.get("serving_spec_accepted_total", 0) > 0
+                 and spec_row.get("accept_rate", 0) > 0),
+                ("spec_verify_dispatches_below_emitted_tokens",
+                 sreg["serving_verify_steps_total"]
+                 < sreg["serving_tokens_out_total"]),
+                ("spec_emitted_per_verify_dispatch_above_one",
+                 sreg["serving_verify_steps_total"] > 0
+                 and sreg["serving_spec_emitted_total"]
+                 > sreg["serving_verify_steps_total"]),
+                ("spec_total_dispatch_win",
+                 sreg["serving_decode_steps_total"]
+                 + sreg["serving_verify_steps_total"]
+                 < spec_off_row["registry"][
+                     "serving_decode_steps_total"]),
+                ("spec_off_zero_verify_dispatches",
+                 spec_off_row["registry"][
+                     "serving_verify_steps_total"] == 0),
             ]
         elif args.router:
             # the full-matrix fleet leg: N replicas, same matrix,

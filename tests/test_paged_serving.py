@@ -96,6 +96,45 @@ def test_paged_kernel_matches_gather_reference():
                                rtol=2e-6, atol=2e-6)
 
 
+def test_paged_kernel_matches_gather_on_verify_expanded_rows():
+    """The speculative verify path (round 16) presents paged attention
+    with ROW-EXPANDED queries: lanes (b, j) sit at consecutive
+    positions pos_b + j and SHARE row b's block table. Both impls must
+    agree on exactly that shape — the kernel's scalar-prefetch index
+    maps see repeated table rows and per-lane pos, the gather
+    reference sees them as ordinary independent rows."""
+    rs = np.random.RandomState(2)
+    b, kk, h, d, bs, nb = 2, 4, 2, 64, 128, 3
+    assert paged_tile_friendly(bs, d)
+    n = 1 + b * nb
+    kp, vp = _rand_pool(rs, n, bs, h, d)
+    q = rs.randn(b * kk, h, d).astype(np.float32)
+    bt = np.arange(1, 1 + b * nb, dtype=np.int32).reshape(b, nb)
+    bt_e = np.repeat(bt, kk, axis=0)
+    pos = (np.array([[100], [250]], np.int32)
+           + np.arange(kk, dtype=np.int32)[None]).reshape(-1)
+    pad = np.repeat(np.array([3, 0], np.int32), kk)
+    want = paged_decode_attention(jnp.asarray(q), jnp.asarray(kp),
+                                  jnp.asarray(vp), block_tables=bt_e,
+                                  pos=pos, pad=pad, impl="xla")
+    got = paged_decode_attention(jnp.asarray(q), jnp.asarray(kp),
+                                 jnp.asarray(vp), block_tables=bt_e,
+                                 pos=pos, pad=pad, impl="pallas")
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=2e-6, atol=2e-6)
+    # the expanded call is ALSO exactly the per-lane single-query call
+    # — lane independence is what the verify program's exactness rides
+    for b_i in range(b):
+        for j in range(kk):
+            r = b_i * kk + j
+            one = paged_decode_attention(
+                jnp.asarray(q[r:r + 1]), jnp.asarray(kp),
+                jnp.asarray(vp), block_tables=bt[b_i:b_i + 1],
+                pos=pos[r:r + 1], pad=pad[r:r + 1], impl="xla")
+            np.testing.assert_array_equal(np.asarray(want[r]),
+                                          np.asarray(one[0]))
+
+
 def test_paged_kernel_rejects_unfriendly_shapes():
     q = jnp.zeros((1, 2, 32))
     kp = jnp.zeros((2, 4, 2, 32))

@@ -144,6 +144,18 @@ def test_chaos_smoke_blocks_exhausted_cancel(tight_dir):
         ["blocks_cancel"], seed=0, tight_dir=d, vocab=vocab))
 
 
+def test_chaos_smoke_spec_verify_fault(chaos_dir):
+    """Round-16: the decode-step fault seam firing DURING a K-token
+    speculative verify dispatch must quarantine/re-dispatch per the
+    PR-10 protocol — transient healed to byte parity with one extra
+    dispatch, repeat failure evicting exactly the newest admission
+    with survivors byte-identical and per-row pos rewound exactly
+    (exact blocks_free recovery)."""
+    d, vocab = chaos_dir
+    _assert_ok(serving_chaos.run_scenarios(
+        ["spec_verify_fault"], seed=0, export_dir=d, vocab=vocab))
+
+
 @pytest.mark.slow
 def test_chaos_soak_cli_all_scenarios():
     """The full soak through the CLI entry (fresh process — the
@@ -156,7 +168,7 @@ def test_chaos_soak_cli_all_scenarios():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     lines = [json.loads(l) for l in proc.stdout.splitlines() if l]
     summary = lines[-1]
-    assert summary["failed"] == 0 and summary["scenarios"] == 7, lines
+    assert summary["failed"] == 0 and summary["scenarios"] == 8, lines
 
 
 # ---------------------------------------------------------------------------
@@ -387,6 +399,51 @@ def test_serving_seams_inert_when_silent(chaos_dir):
                 "router.probe:step=999999;router.forward:step=999999;"
                 "replica.crash:step=999999")
     assert plain == armed
+
+
+def test_spec_seams_inert_when_silent(tmp_path):
+    """The armed-vs-plain inertness harness extended to the SPEC path:
+    an armed-but-silent fault registry over an engine running
+    speculative decoding (verify dispatches probe the same
+    engine.decode_step seam) must stay byte- and dispatch-identical —
+    including the verify-dispatch and accept counters — to no registry
+    at all."""
+    sys.path.insert(0, os.path.join(ROOT, "experiments"))
+    from serving_load import build_export, make_repetitive_requests
+
+    d = str(tmp_path / "spec")
+    vocab = build_export(d, prompt_len=8, max_new=16, slots=4, seed=0,
+                         paged=True, block_size=4, spec_tokens=4)
+    matrix = make_repetitive_requests(1, 4, prompt_len=8, max_new=12,
+                                      vocab=vocab, seed=0)
+    prompts = [p for row in matrix for p, _ in row]
+
+    def run(spec):
+        if spec:
+            faults.install(faults.parse_spec(spec, seed=0))
+        try:
+            eng = _engine(d, spec_tokens=4)
+            try:
+                handles = [eng.submit(p, max_new=12) for p in prompts]
+                outs = [h.result(timeout=120) for h in handles]
+                s = eng.stats()
+                return outs, (s["decode_steps"], s["verify_steps"],
+                              s["prefills"], s["spec_proposed"],
+                              s["spec_accepted"], s["requests_done"],
+                              s["redispatches"])
+            finally:
+                eng.close()
+        finally:
+            faults.install(None)
+
+    plain = run(None)
+    armed = run("engine.decode_step:step=999999;"
+                "engine.prefill:step=999999;engine.admit:step=999999;"
+                "pool.alloc:step=999999")
+    assert plain == armed
+    # the workload genuinely exercised the spec path (else the parity
+    # above would be vacuous)
+    assert plain[1][1] > 0 and plain[1][4] > 0, plain[1]
 
 
 # ---------------------------------------------------------------------------

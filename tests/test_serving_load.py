@@ -28,7 +28,8 @@ def test_smoke_runs_and_holds_parity(capsys):
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
                           "paged_shared", "shared_off", "int8_on",
-                          "tsan_on", "chaos_on", "router_on"}
+                          "tsan_on", "chaos_on", "spec_off", "spec_on",
+                          "router_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -77,6 +78,25 @@ def test_smoke_runs_and_holds_parity(capsys):
     chaos = modes["chaos_on"]
     assert not chaos["errors"]
     assert chaos["registry"]["serving_redispatches_total"] == 1
+    # round-16 spec legs: speculative decoding is EXACT (byte parity
+    # with the spec-off oracle), genuinely accepts drafts on the
+    # repetitive workload, and wins the dispatch count — the
+    # emitted-tokens-per-verify-dispatch > 1.0 acceptance gate
+    assert s["spec_parity_with_off"] is True
+    assert s["spec_accept_rate_positive"] is True
+    assert s["spec_verify_dispatches_below_emitted_tokens"] is True
+    assert s["spec_emitted_per_verify_dispatch_above_one"] is True
+    assert s["spec_total_dispatch_win"] is True
+    assert s["spec_off_zero_verify_dispatches"] is True
+    spec = modes["spec_on"]
+    assert not spec["errors"]
+    assert spec["accept_rate"] > 0
+    assert spec["spec_accepted"] > 0
+    assert spec["verify_steps"] < spec["registry"][
+        "serving_tokens_out_total"]
+    assert (spec["spec_emitted"] / spec["verify_steps"]) > 1.0
+    assert (spec["decode_steps"] + spec["verify_steps"]
+            < modes["spec_off"]["decode_steps"])
     # round-15 router leg: a 2-replica fleet behind serving_router
     # serves the same matrix byte-identically (greedy output cannot
     # depend on which replica answers) with zero client failures
@@ -125,6 +145,12 @@ def test_bench_serving_row_publishes_keys():
     # BELOW the bf16 paged leg's (the capacity lever's observable)
     assert (row["serving_int8_bytes_resident_peak"]
             < row["serving_bytes_resident_peak"])
+    # round-16 speculative columns (gpt_serving_spec_tps /
+    # gpt_serving_accept_rate after key prefixing)
+    assert row["serving_spec_tps"] > 0
+    assert row["serving_spec_errors"] == 0
+    assert 0.0 <= row["serving_accept_rate"] <= 1.0
+    assert row["serving_spec_tokens_per_dispatch"] > 0
 
 
 @pytest.mark.slow
@@ -174,6 +200,30 @@ def test_full_load_matrix_router():
     router = [r for r in rows if r.get("mode") == "router_on"][0]
     assert router["replicas"] == 3 and not router["errors"]
     assert router["tokens_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_full_load_matrix_spec():
+    """Slow-lane speculative leg: the full mixed-length client matrix
+    against a verify-program export with --spec_tokens 4 — the
+    harness's own greedy-parity assertion now covers the spec path at
+    scale (speculation is exact, so `greedy_parity` must hold), and
+    the row publishes the accept-rate story."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--clients", "8", "--requests", "3",
+         "--slots", "8", "--prompt_len", "12", "--max_new", "8",
+         "--paged", "--block_size", "4", "--spec_tokens", "4"],
+        env=env, capture_output=True, text=True, timeout=1200, cwd=ROOT)
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, f"no output:\n{out.stdout}\n{out.stderr[-2000:]}"
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = [r for r in rows if r.get("summary")][0]
+    assert summary["ok"] and summary["greedy_parity"] is True
+    spec = [r for r in rows if r.get("mode") == "spec_on"][0]
+    assert not spec["errors"]
+    assert spec["spec_proposed"] >= spec["spec_accepted"] >= 0
 
 
 @pytest.mark.slow
