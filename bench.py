@@ -546,6 +546,17 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
                                      prompt_len=prompt_len,
                                      mode_name="spec_on",
                                      spec_tokens=4)
+    # fleet router leg (round 17): the same closed-loop matrix through
+    # a 2-replica in-process fleet — `{key}_router_p95_ms` /
+    # `{key}_router_failover_total` / `{key}_router_hedge_win_rate`
+    # open the serving-fleet trajectory (BENCH had no fleet keys), all
+    # sourced from the MERGED registry, not client stopwatches
+    with tempfile.TemporaryDirectory() as d:
+        serving_load.build_export(
+            d, prompt_len=prompt_len, max_new=max_new, slots=slots,
+            model_name=model_name, platforms=platforms)
+        rrow = serving_load.run_router_mode(d, matrix, replicas=2,
+                                            hedge_after_ms=200)
     # counters come from the registry snapshot each run_mode captured
     # (the /metrics exposition = the same atomic snapshot /stats
     # renders) — not re-derived from response bookkeeping, so the
@@ -590,6 +601,16 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
             / max(1, int(srow["registry"]["serving_decode_steps_total"])
                   + int(srow["registry"]["serving_verify_steps_total"])
                   + int(srow["registry"]["serving_prefills_total"])), 3),
+        # round-17 fleet columns: the router trajectory the next TPU
+        # window baselines (ROADMAP items 2/3 name these as their
+        # proof surface)
+        "router_tps": rrow["tokens_per_s"],
+        "router_p95_ms": rrow["fleet_registry_p95_ms"],
+        "router_failover_total": rrow["router_failovers"],
+        "router_hedge_win_rate": round(
+            rrow["router_hedge_wins"] / rrow["router_hedges"], 3)
+        if rrow["router_hedges"] else 0.0,
+        "router_errors": len(rrow["errors"]),
     }
     # per-request latency breakdown (queue vs prefill vs decode) from
     # the request-scoped `timings` field — the p95 gate's diagnosis

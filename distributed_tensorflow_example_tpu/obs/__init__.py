@@ -12,10 +12,18 @@ Three layers (DESIGN.md §14):
 - :mod:`.trace` — span API + ring-buffer recorder dumping
   chrome://tracing / Perfetto trace-event JSON (``POST /trace/start``
   / ``/trace/stop``); shares its event writer with the offline
-  ``utils/trace_summary.py --chrome`` converter.
+  ``utils/trace_summary.py --chrome`` converter. Round 17 adds
+  :class:`~.trace.TraceContext` (``traceparent``-shaped distributed
+  trace propagation) and per-process drain for the fleet stitcher.
+- :mod:`.stitch` — clock-offset estimation + the fleet trace stitcher
+  behind the router's ``GET /trace/fleet`` (DESIGN.md §20).
+- :mod:`.flightrec` — the always-on black-box flight recorder: auto-
+  captured, rate-limited incident bundles off the existing failure
+  seams (DESIGN.md §20).
 """
 
 from .registry import (Counter, Gauge, Histogram, Registry,  # noqa: F401
                        all_registries, merge_snapshots)
-from .trace import (ChromeTraceWriter, TraceRecorder,  # noqa: F401
-                    add_span, recorder, set_recorder, span)
+from .trace import (ChromeTraceWriter, TraceContext,  # noqa: F401
+                    TraceRecorder, add_span, parse_traceparent,
+                    recorder, set_recorder, span)

@@ -82,12 +82,72 @@ def parse(text: str) -> dict[str, float]:
     return out
 
 
+def quantile_from_parsed(parsed: dict[str, float], name: str,
+                         q: float) -> float:
+    """Histogram quantile (Prometheus ``histogram_quantile`` rule:
+    linear interpolation within the first bucket whose cumulative count
+    reaches the rank) from a :func:`parse`-shaped sample dict —
+    ``{name}_bucket{{le=...}}`` series + ``{name}_count``. Returns the
+    upper bound of the +Inf-rank case as the largest finite bound (the
+    conventional clamp), and 0.0 for an empty histogram. The fleet
+    bench keys (``gpt_router_p95_ms``) source percentiles from the
+    MERGED registry through this, not from client-side stopwatches."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    total = parsed.get(f"{name}_count", 0)
+    if not total:
+        return 0.0
+    prefix = f'{name}_bucket{{le="'
+    buckets: list[tuple[float, float]] = []
+    for key, val in parsed.items():
+        if not key.startswith(prefix):
+            continue
+        le = key[len(prefix):-2]          # strip trailing '"}'
+        if le != "+Inf":
+            buckets.append((float(le), val))
+    buckets.sort()
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= rank:
+            if cum == prev_cum:
+                return bound
+            return prev_bound + (bound - prev_bound) * (
+                (rank - prev_cum) / (cum - prev_cum))
+        prev_bound, prev_cum = bound, cum
+    return buckets[-1][0] if buckets else 0.0
+
+
 def _num(val: str) -> int | float:
     """Exposition number -> int when it round-trips exactly (counters
     and gauges rendered from int values must merge back as ints so
     /stats equality checks stay exact)."""
     f = float(val)
     return int(f) if f == int(f) else f
+
+
+def _unescape_help(h: str) -> str:
+    """Exact inverse of :func:`render`'s help escaping (``\\`` then
+    ``\\n``) — what makes ``parse_snapshot(render(s)) == s`` hold even
+    for multi-line help text (the round-trip completeness contract
+    tests/test_obs.py pins)."""
+    out: list[str] = []
+    i = 0
+    while i < len(h):
+        c = h[i]
+        if c == "\\" and i + 1 < len(h):
+            nxt = h[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def parse_snapshot(text: str) -> dict[str, dict]:
@@ -98,8 +158,8 @@ def parse_snapshot(text: str) -> dict[str, dict]:
     side channel to the replicas' in-process registries. Histogram
     ``_bucket`` series are de-cumulated back to per-bucket counts (the
     snapshot layout merge_snapshots sums); ``# TYPE`` lines drive the
-    record shape; ``# HELP`` text is carried through un-unescaped (it
-    only rides display paths)."""
+    record shape; ``# HELP`` text is unescaped back to the registered
+    string, so ``parse_snapshot(render(s)) == s`` exactly."""
     out: dict[str, dict] = {}
     helps: dict[str, str] = {}
     for line in text.splitlines():
@@ -107,7 +167,7 @@ def parse_snapshot(text: str) -> dict[str, dict]:
             continue
         if line.startswith("# HELP "):
             name, _, h = line[len("# HELP "):].partition(" ")
-            helps[name] = h
+            helps[name] = _unescape_help(h)
             continue
         if line.startswith("# TYPE "):
             name, _, kind = line[len("# TYPE "):].partition(" ")

@@ -28,14 +28,113 @@ Lanes are (process, thread) string pairs — e.g. ``("serving",
 integers at dump time. The scheduler gives every cache slot its own
 lane so per-slot spans tile without overlapping; Perfetto renders each
 as one row.
+
+Round 17 — distributed tracing + the always-on ring:
+
+- :class:`TraceContext` carries a W3C-``traceparent``-shaped context
+  (``00-<trace_id:32hex>-<span_id:16hex>-<01|00>``) across process
+  boundaries: the fleet router opens one root context per client
+  request and forwards a child context per attempt; the replica
+  parents its engine spans under it (``trace_id``/``parent_id`` span
+  args), so the fleet stitcher (obs/stitch.py) can reassemble one
+  timeline per request.
+- The recorder gains **per-process drain** (:meth:`TraceRecorder.
+  drain` — ``GET /trace/export`` empties only the exporting server's
+  process label, so N in-process replicas sharing the ring never steal
+  each other's spans) and a non-destructive :meth:`TraceRecorder.tail`
+  (the flight recorder's last-N-spans bundle source).
+- The flight recorder (obs/flightrec.py) runs the ring ALWAYS-ON:
+  servers arm it at construction (without clearing a capture someone
+  else armed) instead of waiting for ``POST /trace/start``, so an
+  incident bundle always has history. The armed per-call cost is one
+  lock + deque append — bounded by the same <2 µs/call guard as the
+  disabled path (tests/test_obs.py).
+- :func:`process_span_stats` accumulates recorded/dropped counts
+  across every recorder this process ever armed — the tier-1 TRACE
+  banner's data source (tests/conftest.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import secrets
 import threading
 import time
 from collections import deque
 from typing import Any
+
+# process-wide span accounting for the tier-1 TRACE banner: survives
+# recorder swaps (set_recorder) the way the registry's name accumulator
+# survives engine teardown. Updated inside the recorder lock.
+_SPAN_TOTALS = {"recorded": 0, "dropped": 0}
+
+
+def process_span_stats() -> dict[str, int]:
+    """{"recorded": N, "dropped": M} across every recorder this process
+    armed — the TRACE line in the tier-1 telemetry banner."""
+    return dict(_SPAN_TOTALS)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One hop of a distributed trace: the ``traceparent`` triple.
+
+    ``trace_id`` names the whole client request fleet-wide;
+    ``span_id`` names the SENDER's span (the receiver's parent);
+    ``sampled`` is the propagated record/don't-record decision (the
+    router's ``--trace_sample`` draw — an unsampled context still
+    carries the ids so logs correlate, but receivers attach no span
+    args for it)."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — one per forward attempt."""
+        return TraceContext(self.trace_id, new_span_id(), self.sampled)
+
+    def to_traceparent(self) -> str:
+        return (f"00-{self.trace_id}-{self.span_id}-"
+                f"{'01' if self.sampled else '00'}")
+
+    def span_args(self) -> dict[str, str]:
+        """The args a receiver merges into spans recorded under this
+        context ({} when unsampled) — trace_id groups, parent_id
+        parents."""
+        if not self.sampled:
+            return {}
+        return {"trace_id": self.trace_id, "parent_id": self.span_id}
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+def parse_traceparent(header: str | None) -> TraceContext | None:
+    """``traceparent`` header -> :class:`TraceContext`, or None for a
+    missing/malformed value (propagation is best-effort: a garbled
+    header must degrade to local-only tracing, never to a 4xx)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return TraceContext(trace_id, span_id,
+                        sampled=bool(int(flags, 16) & 1))
 
 
 class ChromeTraceWriter:
@@ -126,9 +225,40 @@ class TraceRecorder:
         with self._lock:
             if len(self._buf) == self._buf.maxlen:
                 self.events_dropped += 1
+                _SPAN_TOTALS["dropped"] += 1
             self._buf.append((process, lane, name, max(t0, self._t0),
                               max(t1, self._t0), args))
             self.spans_recorded += 1
+            _SPAN_TOTALS["recorded"] += 1
+
+    def drain(self, process: str | None = None) -> list[tuple]:
+        """Remove and return spans (sorted by start time) — ALL of
+        them, or only one ``process`` label's. Per-process drain is the
+        ``GET /trace/export`` contract: N in-process replicas share ONE
+        ring (distinct labels), and each export must empty only its own
+        lane group. Draining does not disarm."""
+        with self._lock:
+            if process is None:
+                items = list(self._buf)
+                self._buf.clear()
+            else:
+                items = [it for it in self._buf if it[0] == process]
+                if items:
+                    keep = [it for it in self._buf if it[0] != process]
+                    self._buf.clear()
+                    self._buf.extend(keep)
+        return sorted(items, key=lambda it: it[3])
+
+    def tail(self, n: int, process: str | None = None) -> list[tuple]:
+        """The newest ``n`` spans (optionally one process label's),
+        WITHOUT removing them — the flight recorder's bundle source
+        (an incident dump must not eat the capture an operator might
+        still export)."""
+        with self._lock:
+            items = [it for it in self._buf
+                     if process is None or it[0] == process]
+        items.sort(key=lambda it: it[3])
+        return items[-n:] if n > 0 else []
 
     def to_chrome(self) -> dict[str, Any]:
         """Ring contents as chrome trace-event JSON (via the shared
@@ -217,6 +347,18 @@ def ensure_capacity(max_events: int) -> TraceRecorder:
     rec = _recorder
     if rec.max_events != max_events and not rec.enabled:
         return set_recorder(TraceRecorder(max_events))
+    return rec
+
+
+def arm_always_on(max_events: int = 65536) -> TraceRecorder:
+    """The flight-recorder arming path: size the process recorder (the
+    usual armed-capture guard applies) and START it — unless a capture
+    is already armed, which must not be cleared out from under its
+    owner (a second in-process server, or an operator's live
+    ``POST /trace/start`` capture). Idempotent."""
+    rec = ensure_capacity(max_events)
+    if not rec.enabled:
+        rec.start()
     return rec
 
 

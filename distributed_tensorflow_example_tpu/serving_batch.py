@@ -159,7 +159,7 @@ import uuid
 
 import numpy as np
 
-from .obs.registry import Registry
+from .obs.registry import SERVING_LATENCY_BUCKETS, Registry
 from .obs.trace import add_span, span
 from .runtime import faults
 from .serving import ServableModel, StepwiseGenerator
@@ -748,6 +748,11 @@ class GenRequest:
     # per-request speculative width: None = the engine's --spec_tokens
     # default, 0 = off for this request, 2..engine width = a cap
     spec_tokens: int | None = None
+    # propagated distributed-trace context (trace_id/parent_id span
+    # args from the router's traceparent header; {} = local-only) —
+    # merged into every span this request's lifecycle records, so the
+    # fleet stitcher parents the slot lane under the router's attempt
+    trace: dict = dataclasses.field(default_factory=dict)
     future: Future = dataclasses.field(default_factory=Future)
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
     t_admit: float = 0.0            # popped from the queue (slot owned)
@@ -886,8 +891,17 @@ class GenerationEngine:
                  default_deadline_ms: int = 0,
                  drain_timeout_s: float = 30.0,
                  stall_after_s: float = 10.0,
-                 spec_tokens: int = 0):
+                 spec_tokens: int = 0,
+                 process: str = "serving",
+                 flight_recorder=None):
         self.sw = stepwise
+        # the trace-lane process label: "serving" standalone; an
+        # in-process fleet gives each replica its own so the shared
+        # ring's per-process drain (GET /trace/export) segregates
+        self.process = str(process)
+        # optional obs.flightrec.FlightRecorder: the engine-fatal and
+        # poison-eviction seams dump incident bundles through it
+        self._flightrec = flight_recorder
         m = stepwise.step_meta
         self.slots: int = int(m["slots"])
         self.prompt_len: int = int(m["prompt_len"])
@@ -1009,19 +1023,28 @@ class GenerationEngine:
             "serving_queue_depth", "requests waiting for admission")
         self._g_live_slots = reg.gauge(
             "serving_live_slots", "cache-pool slots currently decoding")
+        # request-phase histograms register the AUDITED bucket set
+        # (obs/registry.py SERVING_LATENCY_BUCKETS): sub-ms bounds for
+        # the µs-scale queue/prefill phases the 1ms-floored default
+        # collapsed into one bucket; the load harness's saturation
+        # check pins that none of these overflows its top finite bound
         self._h_latency = reg.histogram(
             "serving_request_latency_seconds",
-            "submit-to-retirement request latency")
+            "submit-to-retirement request latency",
+            buckets=SERVING_LATENCY_BUCKETS)
         self._h_queue_wait = reg.histogram(
             "serving_request_queue_seconds",
-            "submit-to-admission queue wait")
+            "submit-to-admission queue wait",
+            buckets=SERVING_LATENCY_BUCKETS)
         self._h_prefill = reg.histogram(
             "serving_request_prefill_seconds",
             "admission-to-first-sample time (prefill or cached mount + "
-            "teacher-forced suffix)")
+            "teacher-forced suffix)",
+            buckets=SERVING_LATENCY_BUCKETS)
         self._h_decode = reg.histogram(
             "serving_request_decode_seconds",
-            "first-sample-to-retirement decode time")
+            "first-sample-to-retirement decode time",
+            buckets=SERVING_LATENCY_BUCKETS)
         self._latencies: deque[float] = deque(maxlen=2048)
         # slot-lane bookkeeping: when slot i last freed, so a reused
         # slot's queue-wait span is clamped to its own tenancy (the
@@ -1326,7 +1349,10 @@ class GenerationEngine:
         for invalid client inputs (clear faults naming the limit),
         :class:`QueueFullError` at ``max_queue``, and
         :class:`DrainingError` during a graceful drain."""
+        trace = kw.pop("trace", None)
         req = self._make_request(prompt, **kw)
+        if trace:
+            req.trace = dict(trace)
         self._enqueue([req])
         return EngineHandle(self, req)
 
@@ -1339,12 +1365,15 @@ class GenerationEngine:
 
     def submit_many_requests(self, prompts, *,
                              request_ids: list[str] | None = None,
+                             trace: dict | None = None,
                              **kw) -> list[GenRequest]:
         """Like :meth:`submit_many` but returns the
         :class:`GenRequest` objects, whose ``request_id``/``timings``
         the HTTP layer reads after the future resolves. ``request_ids``
         (one per prompt) propagates caller-supplied ids (the
-        ``X-Request-Id`` path)."""
+        ``X-Request-Id`` path); ``trace`` (the parsed ``traceparent``
+        span args) parents every row's lifecycle spans under the
+        router's forward attempt instead of a fresh local root."""
         if request_ids is not None and len(request_ids) != len(prompts):
             raise ValueError(
                 f"{len(request_ids)} request ids for {len(prompts)} "
@@ -1354,6 +1383,9 @@ class GenerationEngine:
             p, seed=seed + i,
             request_id=request_ids[i] if request_ids else None, **kw)
             for i, p in enumerate(prompts)]
+        if trace:
+            for r in reqs:
+                r.trace = dict(trace)
         self._enqueue(reqs)
         return reqs
 
@@ -1416,6 +1448,30 @@ class GenerationEngine:
                 "stall_after_s": self.stall_after_s,
                 "queue_depth": queued, "inflight": inflight,
                 "draining": draining}
+
+    def set_stall_after(self, stall_after_s: float,
+                        settle_timeout_s: float = 2.0) -> None:
+        """Retune the watchdog threshold on a LIVE engine (chaos
+        harnesses tighten it after XLA-compile warm-up; a supervisor
+        could relax it under load). Order matters: the idle park is
+        recomputed (the round-15 ``min(0.5, stall/4)`` rule) and the
+        scheduler woken FIRST, then this waits (bounded) for a fresh
+        heartbeat before the tighter threshold applies — tightening
+        against a thread still parked on the OLD wait would flap a
+        perfectly healthy idle engine stalled for up to half a
+        second."""
+        if stall_after_s <= 0:
+            raise ValueError(f"stall_after_s must be > 0, got "
+                             f"{stall_after_s}")
+        self._idle_wait_s = min(0.5, max(0.01, stall_after_s / 4.0))
+        with self._cond:
+            self._cond.notify_all()
+        deadline = time.monotonic() + settle_timeout_s
+        while (time.monotonic() - self._heartbeat
+               > min(0.1, stall_after_s / 2.0)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        self.stall_after_s = float(stall_after_s)
 
     def drain(self, timeout_s: float | None = None) -> float:
         """Graceful shutdown: stop admitting (``submit`` raises
@@ -1568,6 +1624,11 @@ class GenerationEngine:
                 log.warning("engine-fatal scheduler fault (%d live "
                             "request(s) failed, pool rebuilt): %s",
                             len(self._live), e)
+                if self._flightrec is not None:
+                    self._flightrec.incident(
+                        "engine_fatal_rebuild",
+                        detail=f"{type(e).__name__}: {e}",
+                        extra={"live_requests": len(self._live)})
                 with self._cond:
                     if self._admitting is not None:
                         self._admitting.future.set_exception(err)
@@ -1696,10 +1757,11 @@ class GenerationEngine:
             # wait rides the args and the timings breakdown
             add_span("queue_wait",
                      max(req.submitted_at, self._slot_freed_t[index]),
-                     req.t_admit, lane=f"slot{index}",
+                     req.t_admit, process=self.process,
+                     lane=f"slot{index}",
                      request_id=req.request_id,
                      queued_ms=round((req.t_admit - req.submitted_at)
-                                     * 1e3, 3))
+                                     * 1e3, 3), **req.trace)
             try:
                 faults.inject("engine.admit", detail=req.request_id)
                 if self.paged:
@@ -1776,8 +1838,9 @@ class GenerationEngine:
         p = req.prompt.size
         ids[0, :p] = req.prompt
         mask[0, :p] = 1
-        with span("prefill", lane=f"slot{index}",
-                  request_id=req.request_id, prompt_tokens=p):
+        with span("prefill", process=self.process, lane=f"slot{index}",
+                  request_id=req.request_id, prompt_tokens=p,
+                  **req.trace):
             faults.inject("engine.prefill", detail=req.request_id)
             out = self.sw.prefill({
                 "input_ids": ids, "prompt_mask": mask,
@@ -1822,9 +1885,10 @@ class GenerationEngine:
             # the last prompt token — its logits are the first sample
             # point, and its write copy-on-writes the shared tail block.
             start = n_hit - 1 if n_hit == p else n_hit
-            with span("prefill", lane=f"slot{index}",
+            with span("prefill", process=self.process,
+                      lane=f"slot{index}",
                       request_id=req.request_id, prompt_tokens=p,
-                      cached_tokens=start):
+                      cached_tokens=start, **req.trace):
                 self.blocks.retain(hit_blocks)
                 self._tables[index, :len(hit_blocks)] = hit_blocks
             with self.registry.atomic():
@@ -1877,8 +1941,10 @@ class GenerationEngine:
         ids[0, :p] = tokens
         mask[0, :p] = 1
         try:
-            with span("prefill", lane=f"slot{index}",
-                      request_id=req.request_id, prompt_tokens=p):
+            with span("prefill", process=self.process,
+                      lane=f"slot{index}",
+                      request_id=req.request_id, prompt_tokens=p,
+                      **req.trace):
                 faults.inject("engine.prefill", detail=req.request_id)
                 out = self.sw.prefill({
                     "input_ids": ids, "prompt_mask": mask,
@@ -1971,9 +2037,11 @@ class GenerationEngine:
                 # with the slot's long decode window, and slot lanes
                 # must stay non-overlapping); the request id keeps
                 # correlation
-                with span("cow_copy", lane="scheduler",
+                with span("cow_copy", process=self.process,
+                          lane="scheduler",
                           request_id=slot.req.request_id,
-                          slot=slot.index, block=pb):
+                          slot=slot.index, block=pb,
+                          **slot.req.trace):
                     if self.blocks.free_count < 1 \
                             and self.prefix_cache is not None:
                         self.prefix_cache.evict(1)
@@ -2065,13 +2133,14 @@ class GenerationEngine:
         # the slot lane tiles: [queue_wait][prefill][forced?][decode][retire]
         if slot.t_forced_done > slot.t_prefill_done:
             add_span("forced_suffix", slot.t_prefill_done,
-                     slot.t_forced_done, lane=lane,
-                     request_id=req.request_id)
+                     slot.t_forced_done, process=self.process,
+                     lane=lane, request_id=req.request_id, **req.trace)
         if req.t_first:
             add_span("decode", max(req.t_first, slot.t_forced_done,
                                    slot.t_prefill_done), t_ret,
-                     lane=lane, request_id=req.request_id,
-                     tokens=len(slot.tokens))
+                     process=self.process, lane=lane,
+                     request_id=req.request_id,
+                     tokens=len(slot.tokens), **req.trace)
         req.timings = {
             "request_id": req.request_id,
             "queue_ms": round((req.t_admit - req.submitted_at) * 1e3, 3),
@@ -2087,7 +2156,8 @@ class GenerationEngine:
             # of serving_spec_accepted_total
             "spec_accepted": slot.spec_accepted,
         }
-        with span("retire", lane=lane, request_id=req.request_id):
+        with span("retire", process=self.process, lane=lane,
+                  request_id=req.request_id, **req.trace):
             if self.paged:
                 self._release_slot_blocks(slot.index)
             with self._cond:
@@ -2196,7 +2266,8 @@ class GenerationEngine:
                     # rules stay one-shot transients, p-rules resample
                     reg.raise_if_armed("engine.decode_step", index=idx,
                                        attempt=attempt)
-                with span(span_name, lane="scheduler",
+                with span(span_name, process=self.process,
+                          lane="scheduler",
                           slots=int(feats["alive"].sum())):
                     out = call(feats)
                     # blocks on the result BEFORE adopting the returned
@@ -2226,6 +2297,13 @@ class GenerationEngine:
                             "re-dispatching %d survivor(s): %s",
                             span_name, victim.req.request_id,
                             len(self._live) - 1, e)
+                if self._flightrec is not None:
+                    self._flightrec.incident(
+                        "poison_eviction",
+                        detail=f"request {victim.req.request_id}: "
+                               f"{type(e).__name__}: {e}",
+                        extra={"survivors": len(self._live) - 1,
+                               "dispatch": span_name})
                 self._fail_slot(victim, PoisonedRequestError(
                     f"request {victim.req.request_id} evicted after "
                     f"repeated shared-decode failure "
@@ -2527,7 +2605,9 @@ class MicroBatcher:
 
     def __init__(self, servable: ServableModel, *,
                  batch_max_size: int = 8, batch_max_wait_ms: float = 5.0,
-                 max_queue: int = 256, registry: Registry | None = None):
+                 max_queue: int = 256, registry: Registry | None = None,
+                 process: str = "serving"):
+        self.process = str(process)
         if batch_max_size < 1:
             raise ValueError(f"batch_max_size must be >= 1, got "
                              f"{batch_max_size}")
@@ -2696,7 +2776,8 @@ class MicroBatcher:
                 [v, np.repeat(v[:1], bucket - n_total, axis=0)])
                 for k, v in cols.items()}
         t0 = time.perf_counter()
-        with span("predict_batch", lane="batcher", rows=n_total,
+        with span("predict_batch", process=self.process,
+                  lane="batcher", rows=n_total,
                   bucket=bucket):
             preds = np.asarray(self.servable(cols))
         self._retry.observe(time.perf_counter() - t0)

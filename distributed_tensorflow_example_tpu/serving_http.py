@@ -118,12 +118,28 @@ draining) drives the router's replica state machine, ``POST
 /cancel/<rid>`` is the hedging loser-cancellation path, and
 :meth:`PredictServer.kill` is the chaos harness's crash switch
 (listener down NOW, no drain — the ``replica.crash`` seam).
+
+Distributed tracing + flight recorder (round 17, DESIGN.md §20): an
+inbound ``traceparent`` header (the router's per-attempt context)
+parents the engine's slot-lane spans under the fleet trace instead of
+a fresh local root, and ``:generate`` responses return ``trace_id``
+beside ``request_ids``; ``GET /trace/export`` drains this server's
+spans (its own process label — in-process fleet replicas share one
+ring) for the router's ``GET /trace/fleet`` stitcher, and ``/healthz``
+carries ``mono_now`` for the stitcher's clock-offset estimate. With
+``--flight_recorder on`` (default) the span ring runs ALWAYS-ON and
+the failure seams (watchdog stall here; engine-fatal rebuild and
+poison eviction in the engine) auto-write rate-limited incident
+bundles to ``--incident_dir`` — registry snapshot, span tail,
+request-log tail, config fingerprint — with ``off`` byte- and
+dispatch-identical (armed-vs-plain parity, tier-1).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
@@ -131,6 +147,7 @@ import numpy as np
 
 from .obs import prom as obs_prom
 from .obs import trace as obs_trace
+from .obs.flightrec import FlightRecorder
 from .obs.registry import Registry
 from .runtime import faults
 from .serving import ServableModel, has_stepwise, load_servable
@@ -169,12 +186,19 @@ class PredictServer:
                  default_deadline_ms: int = 0,
                  drain_timeout_s: float = 30.0,
                  stall_after_s: float = 10.0,
-                 spec_tokens: int = 0):
+                 spec_tokens: int = 0,
+                 process_name: str | None = None,
+                 flight_recorder: bool = True,
+                 incident_dir: str | None = None):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
         self.servable: ServableModel = load_servable(export_dir)
         self.name = name or self.servable.meta.get("model", "model")
+        # trace-lane process label: "serving" standalone; an in-process
+        # fleet names each replica so the shared ring's per-process
+        # drain (GET /trace/export) segregates their spans
+        self.process_name = process_name or "serving"
         # one registry for the whole server (engine/batcher counters +
         # the HTTP-level ones below); metrics=False disables every
         # increment behind a single branch
@@ -199,10 +223,41 @@ class PredictServer:
         if request_log:
             from .utils.metrics import MetricsLogger
             self._request_logger = MetricsLogger(request_log)
-        # the span recorder is armed via POST /trace/start; the resize
-        # guard (skip when another owner's capture is armed) lives in
-        # obs.trace.ensure_capacity
-        obs_trace.ensure_capacity(trace_buffer_events)
+        # flight recorder (round 17): the bounded ring runs ALWAYS-ON
+        # (arm_always_on never clears a capture someone else armed), so
+        # an incident bundle has history without anyone having POSTed
+        # /trace/start first; --flight_recorder off reverts to the
+        # armed-on-demand ring (byte- and dispatch-identical serving —
+        # the armed-vs-plain parity contract)
+        if flight_recorder:
+            obs_trace.arm_always_on(trace_buffer_events)
+        else:
+            obs_trace.ensure_capacity(trace_buffer_events)
+        self._c_incidents = self.registry.counter(
+            "serving_incidents_total",
+            "incident bundles written by the flight recorder")
+        self._c_incidents_suppressed = self.registry.counter(
+            "serving_incidents_suppressed_total",
+            "incident bundles suppressed by the per-cause rate limit")
+        self._flightrec = None
+        if flight_recorder and incident_dir:
+            self._flightrec = FlightRecorder(
+                incident_dir, process=self.process_name,
+                snapshot_fn=self._metrics_snapshot,
+                config={"scheduler": scheduler,
+                        "max_queue": max_queue,
+                        "prefix_cache": prefix_cache,
+                        "metrics": metrics,
+                        "trace_buffer_events": trace_buffer_events,
+                        "default_deadline_ms": default_deadline_ms,
+                        "drain_timeout_s": drain_timeout_s,
+                        "stall_after_s": stall_after_s,
+                        "spec_tokens": spec_tokens,
+                        "export_dir": export_dir,
+                        "model": self.name},
+                request_log_path=request_log,
+                counter=self._c_incidents,
+                suppressed_counter=self._c_incidents_suppressed)
         # the single-flight lock for the direct path: _execute is called
         # from ThreadingHTTPServer handler threads, and nothing else
         # serializes the executable (the scheduler paths serialize by
@@ -268,13 +323,16 @@ class PredictServer:
                     default_deadline_ms=default_deadline_ms,
                     drain_timeout_s=drain_timeout_s,
                     stall_after_s=stall_after_s,
-                    spec_tokens=spec_tokens).start()
+                    spec_tokens=spec_tokens,
+                    process=self.process_name,
+                    flight_recorder=self._flightrec).start()
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
                     batch_max_wait_ms=batch_max_wait_ms,
                     max_queue=max_queue,
-                    registry=self.registry).start()
+                    registry=self.registry,
+                    process=self.process_name).start()
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
@@ -392,7 +450,8 @@ class PredictServer:
             raise _ServerFault(f"{type(e).__name__}: {e}") from e
 
     def predict(self, payload: dict,
-                request_id: str | None = None) -> dict:
+                request_id: str | None = None,
+                trace: obs_trace.TraceContext | None = None) -> dict:
         if self.servable.meta.get("kind") == "generator":
             raise ValueError(
                 "this artifact is a generator — POST to :generate")
@@ -439,7 +498,9 @@ class PredictServer:
                     "larger prompt_len to serve longer prompts)")
 
     def _generate_scheduled(self, payload: dict,
-                            request_id: str | None = None) -> dict:
+                            request_id: str | None = None,
+                            trace: obs_trace.TraceContext | None = None
+                            ) -> dict:
         """:generate via the continuous-batching engine: each instance
         row becomes one scheduler request (row i of a multi-row request
         samples under ``seed + i`` so rows stay independent). Rows may
@@ -539,8 +600,14 @@ class PredictServer:
         # wall-timeout — a handler thread giving up must return the
         # slot + cache blocks to the pool, not abandon a request
         # decoding to max_new (the round-9 leak)
+        # a propagated traceparent (the router's forward attempt)
+        # parents the engine's slot-lane spans instead of a fresh
+        # local root; an unsampled context contributes nothing
+        trace_args = trace.span_args() if trace is not None else {}
         handles = self.engine.submit_many(prompts, seed=seed,
-                                          request_ids=rids, **kw)
+                                          request_ids=rids,
+                                          trace=trace_args or None,
+                                          **kw)
 
         def wait_all() -> list:
             try:
@@ -561,12 +628,19 @@ class PredictServer:
             raise          # the handler maps these to 504 / 409
         except (TimeoutError, RuntimeError) as e:
             raise _ServerFault(f"{type(e).__name__}: {e}") from e
-        return {"generations": gens,
-                "request_ids": [h.request_id for h in handles],
-                "timings": [h.timings for h in handles]}
+        out = {"generations": gens,
+               "request_ids": [h.request_id for h in handles],
+               "timings": [h.timings for h in handles]}
+        if trace is not None:
+            # the trace id rides the response beside request_ids so a
+            # client (or the router's annotation) can fetch the
+            # stitched timeline for exactly this request
+            out["trace_id"] = trace.trace_id
+        return out
 
     def generate(self, payload: dict,
-                 request_id: str | None = None) -> dict:
+                 request_id: str | None = None,
+                 trace: obs_trace.TraceContext | None = None) -> dict:
         """The decode route: ``{"inputs": {"input_ids": [[...]], ...},
         "seed": 7}`` -> ``{"generations": [[token ids]]}``. The ``rng``
         artifact input (present when the artifact samples) is NOT a
@@ -580,7 +654,7 @@ class PredictServer:
                 "this artifact is not a generator — POST to :predict "
                 "(export with export_generator for a decode artifact)")
         if self.engine is not None:
-            return self._generate_scheduled(payload, request_id)
+            return self._generate_scheduled(payload, request_id, trace)
         # engine-only payload knobs must not be silently ignored: the
         # monolithic program cannot truncate on stop_sequences or
         # speculate, and a 200 that quietly dropped the contract is
@@ -702,6 +776,11 @@ class PredictServer:
                     # blackholing traffic behind a listening socket
                     h = server.health()
                     self._send(200 if h["status"] == "live" else 503, h)
+                elif self.path in ("/trace/export",
+                                   f"/v1/models/{server.name}"
+                                   "/trace/export"):
+                    # per-replica span drain for the fleet stitcher
+                    self._send(200, server.trace_export())
                 else:
                     self._send(404, {"error": f"unknown path {self.path}"})
 
@@ -749,7 +828,9 @@ class PredictServer:
                 try:
                     self._send(200, route(
                         payload,
-                        self.headers.get("X-Request-Id") or None))
+                        self.headers.get("X-Request-Id") or None,
+                        obs_trace.parse_traceparent(
+                            self.headers.get("traceparent"))))
                 except QueueFullError as e:
                     # bounded admission: tell the client WHEN to come
                     # back instead of silently stacking handler threads
@@ -825,14 +906,47 @@ class PredictServer:
         rec.stop()
         return rec.to_chrome()
 
+    def trace_export(self) -> dict:
+        """``GET /trace/export``: DRAIN this server's spans (its own
+        process label only — N in-process replicas share one ring) as
+        JSON for the fleet stitcher, with the local monotonic clock
+        beside them so the router's offset estimate has an anchor.
+        ``events_dropped`` is the RING's count: per-process drop
+        attribution is not tracked, so in-process fleets (shared ring)
+        over-report it per export — the stitched metadata's sum is
+        exact only for the production one-ring-per-process shape."""
+        rec = obs_trace.recorder()
+        spans = rec.drain(process=self.process_name)
+        return {"process": self.process_name,
+                "clock": time.perf_counter(),
+                "spans": [[p, lane, name, t0, t1, args]
+                          for p, lane, name, t0, t1, args in spans],
+                "events_dropped": rec.events_dropped,
+                "enabled": rec.enabled}
+
     def health(self) -> dict:
         """``GET /healthz``: the engine's watchdog view (live / stalled
-        / dead with the heartbeat age). Without a scheduler thread to
-        watch (scheduler off, or a predict artifact) the server
-        answering at all IS the liveness signal."""
+        / dead with the heartbeat age), plus ``mono_now`` (this
+        process's ``perf_counter``) — the clock sample the router's
+        per-replica offset estimation reads off every probe. A stalled
+        watchdog also fires the flight recorder (cause
+        ``watchdog_stall``, rate-limited): the probe that demotes the
+        replica is the incident's own evidence, no arming required.
+        Without a scheduler thread to watch (scheduler off, or a
+        predict artifact) the server answering at all IS the liveness
+        signal."""
         if self.engine is not None:
-            return self.engine.health()
-        return {"status": "live", "scheduler": self.scheduler}
+            h = self.engine.health()
+            if h["status"] == "stalled" and self._flightrec is not None:
+                self._flightrec.incident(
+                    "watchdog_stall",
+                    detail=f"heartbeat {h['heartbeat_age_s']}s old "
+                           f"(stall_after_s {h['stall_after_s']})",
+                    extra={"health": h})
+        else:
+            h = {"status": "live", "scheduler": self.scheduler}
+        h["mono_now"] = time.perf_counter()
+        return h
 
     def cancel(self, request_id: str) -> bool:
         """``POST /cancel/<request_id>``: cancel a queued or live
@@ -981,6 +1095,19 @@ def main(argv=None) -> int:
     ap.add_argument("--stall_after_s", type=float, default=10.0,
                     help="GET /healthz reports 'stalled' (503) once the "
                     "scheduler heartbeat is older than this")
+    ap.add_argument("--flight_recorder", choices=("on", "off"),
+                    default="on",
+                    help="always-on span ring + auto incident bundles "
+                    "(on, the default: the bounded ring records "
+                    "without POST /trace/start so failures have "
+                    "history; off: byte- and dispatch-identical "
+                    "serving with the ring armed on demand only)")
+    ap.add_argument("--incident_dir", default=None,
+                    help="directory for flight-recorder incident "
+                    "bundles (engine-fatal rebuild, watchdog stall, "
+                    "poison eviction), one timestamped JSON per "
+                    "incident, rate-limited per cause; unset = no "
+                    "bundles are written even with the recorder on")
     ap.add_argument("--fault_spec", default=None,
                     help="arm the serving fault seams (engine.prefill / "
                     "engine.decode_step / engine.admit / pool.alloc / "
@@ -1006,7 +1133,9 @@ def main(argv=None) -> int:
                         default_deadline_ms=args.default_deadline_ms,
                         drain_timeout_s=args.drain_timeout_s,
                         stall_after_s=args.stall_after_s,
-                        spec_tokens=args.spec_tokens)
+                        spec_tokens=args.spec_tokens,
+                        flight_recorder=args.flight_recorder == "on",
+                        incident_dir=args.incident_dir)
 
     def _graceful(signum, frame):
         # stop() must run off the serve_forever thread (shutdown()

@@ -81,6 +81,21 @@ second attempt — so the id in the replica's response, request log and
 trace is end-to-end stable; the ``served_by`` response field names the
 replica that actually answered.
 
+- **Distributed tracing + flight recorder** (round 17, DESIGN.md §20)
+  — every client request opens a ROOT trace context (trace id + root
+  span id + the ``--trace_sample`` sampled flag); each routing
+  decision — pick, per-attempt forward (launch marker + completed
+  span), retry with its reason, pushback skip, hedge wave (whose span
+  PARENTS both attempts), hedge launch, loser cancellation — is a
+  child span, and the ``traceparent`` header forwards a per-attempt
+  child context so replicas parent their engine spans under it.
+  ``GET /trace/fleet`` stitches the router's drain with every
+  replica's ``GET /trace/export`` into ONE Perfetto timeline (router
+  lane on top, one process group per replica, clock offsets estimated
+  from probe stamps + ``/healthz mono_now``). The router's own flight
+  recorder bundles ``breaker_open`` / ``replica_death`` incidents to
+  ``--incident_dir``.
+
 Fault seams (:mod:`~.runtime.faults`, inert single ``None``-checks by
 default): ``router.probe`` (a health probe fails), ``router.forward``
 (a forwarded request drops on the network floor), ``replica.crash``
@@ -98,6 +113,7 @@ import json
 import random
 import threading
 import time
+from collections import deque
 import urllib.error
 import urllib.request
 import uuid
@@ -106,7 +122,12 @@ from queue import Empty, Queue
 from typing import Any
 
 from .obs import prom as obs_prom
-from .obs.registry import Registry, merge_snapshots
+from .obs import stitch as obs_stitch
+from .obs import trace as obs_trace
+from .obs.flightrec import FlightRecorder
+from .obs.registry import (SERVING_LATENCY_BUCKETS, Registry,
+                           merge_snapshots)
+from .obs.trace import TraceContext, add_span, new_span_id, new_trace_id
 from .runtime import faults
 from .serving_batch import (RetryAfterEstimator, scheduler_owned,
                             scheduler_thread, snapshot_view)
@@ -257,7 +278,7 @@ class Replica:
             self.crash_fn()
 
 
-@scheduler_owned("_states", "_probe_failures")
+@scheduler_owned("_states", "_probe_failures", "_clock_samples")
 class ReplicaRouter:
     """One client-facing address over N replicas (module docstring).
 
@@ -282,7 +303,10 @@ class ReplicaRouter:
                  forward_timeout_s: float = 300.0,
                  backoff_base_ms: float = 20.0,
                  backoff_cap_ms: float = 500.0,
-                 seed: int = 0, metrics: bool = True):
+                 seed: int = 0, metrics: bool = True,
+                 trace_sample: float = 1.0,
+                 flight_recorder: bool = True,
+                 incident_dir: str | None = None):
         self.replicas = [r if isinstance(r, Replica) else Replica(r)
                          for r in replicas]
         if not self.replicas:
@@ -319,11 +343,20 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._outstanding = {r.name: 0 for r in self.replicas}
         self._rng = random.Random(seed)
+        if not 0.0 <= trace_sample <= 1.0:
+            raise ValueError(f"trace_sample must be in [0, 1], got "
+                             f"{trace_sample}")
+        self.trace_sample = float(trace_sample)
         # ---- probe-thread-owned state (THR01) -----------------------
         self._states: dict[str, str] = {r.name: "unknown"
                                         for r in self.replicas}
         self._probe_failures: dict[str, int] = {r.name: 0
                                                 for r in self.replicas}
+        # per-replica (t_send, t_recv, remote mono_now) probe stamps —
+        # the clock-offset estimator's input (obs/stitch.py); the probe
+        # thread appends, /trace/fleet reads a snapshot copy
+        self._clock_samples: dict[str, deque] = {
+            r.name: deque(maxlen=32) for r in self.replicas}
         self._stop = threading.Event()
         self._probed_once = threading.Event()
         self._probe_thread: threading.Thread | None = None
@@ -352,6 +385,40 @@ class ReplicaRouter:
         self._g_replica_healthy = reg.gauge(
             "router_replica_healthy",
             "replicas currently in the healthy state")
+        self._c_hedge_wins = reg.counter(
+            "router_hedge_wins_total",
+            "hedged second attempts that answered before the primary")
+        self._h_request = reg.histogram(
+            "router_request_seconds",
+            "client-visible request wall time at the router (all "
+            "attempts, retries and hedges included)",
+            buckets=SERVING_LATENCY_BUCKETS)
+        self._c_incidents = reg.counter(
+            "router_incidents_total",
+            "incident bundles written by the router's flight recorder")
+        self._c_incidents_suppressed = reg.counter(
+            "router_incidents_suppressed_total",
+            "router incident bundles suppressed by the per-cause rate "
+            "limit")
+        # flight recorder (round 17): always-on ring + auto bundles on
+        # breaker-open / replica-death, mirroring the replica side
+        if flight_recorder:
+            obs_trace.arm_always_on()
+        self._flightrec = None
+        if flight_recorder and incident_dir:
+            self._flightrec = FlightRecorder(
+                incident_dir, process="router",
+                snapshot_fn=self.registry.snapshot,
+                config={"name": name, "replicas":
+                        [r.url for r in self.replicas],
+                        "retry_budget": retry_budget,
+                        "hedge_after_ms": hedge_after_ms,
+                        "breaker_threshold": breaker_threshold,
+                        "probe_interval_s": probe_interval_s,
+                        "dead_after_probes": dead_after_probes,
+                        "trace_sample": trace_sample},
+                counter=self._c_incidents,
+                suppressed_counter=self._c_incidents_suppressed)
         self._httpd = ThreadingHTTPServer((host, port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
@@ -371,6 +438,7 @@ class ReplicaRouter:
     @scheduler_thread
     def _probe_one(self, r: Replica) -> None:
         self._c_probes.inc()
+        t_send = time.perf_counter()
         try:
             faults.inject("router.probe", detail=r.name)
             status, body = self._get_json(r, "/healthz",
@@ -388,8 +456,21 @@ class ReplicaRouter:
                 if r.breaker.record_failure():
                     self._c_breaker_open.inc()
                     log.warning("breaker OPEN for %s (%s)", r.name, e)
+                    if self._flightrec is not None:
+                        self._flightrec.incident(
+                            "breaker_open",
+                            detail=f"replica {r.name}: probe failure "
+                                   f"({e})",
+                            extra={"replica": r.name,
+                                   "breakers": self._breaker_states()})
             return
         self._probe_failures[r.name] = 0
+        # the replica's /healthz carries its own monotonic clock — one
+        # (t_send, t_recv, remote_now) sample per successful probe
+        # feeds the fleet stitcher's per-replica offset estimate
+        if isinstance(body.get("mono_now"), (int, float)):
+            self._clock_samples[r.name].append(
+                (t_send, time.perf_counter(), float(body["mono_now"])))
         if body.get("draining"):
             # graceful shutdown in progress: in-flight work finishes,
             # new admissions belong elsewhere — and this is NOT a
@@ -413,12 +494,31 @@ class ReplicaRouter:
         prev = self._states[r.name]
         if prev != state:
             log.warning("replica %s: %s -> %s", r.name, prev, state)
+            if state == "dead" and self._flightrec is not None:
+                self._flightrec.incident(
+                    "replica_death",
+                    detail=f"replica {r.name}: {prev} -> dead after "
+                           f"{self.dead_after_probes} failed probe(s)",
+                    extra={"replica": r.name,
+                           "states": dict(self._states),
+                           "breakers": self._breaker_states()})
         self._states[r.name] = state
+
+    def _breaker_states(self) -> dict[str, str]:
+        return {r.name: r.breaker.state for r in self.replicas}
 
     @snapshot_view
     def replica_states(self) -> dict[str, str]:
         """Cross-thread copy of the probe thread's state map."""
         return dict(self._states)
+
+    @snapshot_view
+    def clock_samples(self) -> dict[str, list]:
+        """Cross-thread copy of the probe thread's per-replica
+        (t_send, t_recv, remote_now) stamps — the stitcher's offset
+        input."""
+        return {name: list(d) for name, d in
+                self._clock_samples.items()}
 
     # ---- routing -----------------------------------------------------
     def _pick(self, excluded: set[str],
@@ -454,13 +554,17 @@ class ReplicaRouter:
 
     # ---- forwarding --------------------------------------------------
     def _forward(self, r: Replica, path: str, body: bytes, rid: str,
-                 timeout_s: float) -> tuple[int, dict, bytes]:
+                 timeout_s: float,
+                 trace: TraceContext | None = None
+                 ) -> tuple[int, dict, bytes]:
         """One forward attempt: ``(status, headers, body)`` for ANY
         HTTP-level response (4xx/5xx included); :class:`ForwardError`
-        for failures below HTTP. The ``replica.crash`` seam fires
-        FIRST — an armed rule hard-kills the target (in-process
-        fleets) and surfaces the connection error a mid-request crash
-        produces."""
+        for failures below HTTP. ``trace`` (this attempt's child
+        context) rides the ``traceparent`` header so the replica
+        parents its slot-lane spans under the attempt. The
+        ``replica.crash`` seam fires FIRST — an armed rule hard-kills
+        the target (in-process fleets) and surfaces the connection
+        error a mid-request crash produces."""
         try:
             faults.inject("replica.crash", detail=r.name)
         except Exception as e:
@@ -470,10 +574,12 @@ class ReplicaRouter:
                                f"({e})") from e
         try:
             faults.inject("router.forward", detail=r.name)
+            headers = {"Content-Type": "application/json",
+                       "X-Request-Id": rid}
+            if trace is not None:
+                headers["traceparent"] = trace.to_traceparent()
             req = urllib.request.Request(
-                r.url + path, data=body,
-                headers={"Content-Type": "application/json",
-                         "X-Request-Id": rid})
+                r.url + path, data=body, headers=headers)
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 return resp.status, dict(resp.headers), resp.read()
         except urllib.error.HTTPError as e:
@@ -502,6 +608,12 @@ class ReplicaRouter:
             self._c_breaker_open.inc()
             log.warning("breaker OPEN for %s (forward failures)",
                         r.name)
+            if self._flightrec is not None:
+                self._flightrec.incident(
+                    "breaker_open",
+                    detail=f"replica {r.name}: forward failures",
+                    extra={"replica": r.name,
+                           "breakers": self._breaker_states()})
 
     def _inc_outstanding(self, r: Replica, n: int) -> None:
         with self._lock:
@@ -520,18 +632,31 @@ class ReplicaRouter:
         n = len(rows) if isinstance(rows, list) else 1
         return [rid] if n <= 1 else [f"{rid}-{i}" for i in range(n)]
 
-    def _cancel_on(self, r: Replica, rids: list[str]) -> None:
+    def _cancel_on(self, r: Replica, rids: list[str],
+                   ctx: TraceContext | None = None,
+                   parent_id: str | None = None) -> None:
         """Fire-and-forget cancellation of the hedging loser's rows —
         best-effort by design (the loser may retire first; a dead
-        loser has nothing to cancel)."""
+        loser has nothing to cancel). Each cancellation records a
+        "cancel" span under the hedge wave's span, carrying the SAME
+        request id — the stitched timeline's proof the loser was told
+        to stop."""
         def go():
             for one in rids:
+                t0 = time.perf_counter()
                 try:
                     req = urllib.request.Request(
                         f"{r.url}/cancel/{one}", data=b"")
                     urllib.request.urlopen(req, timeout=5).close()
-                except Exception:
-                    pass
+                    outcome = "acknowledged"
+                except Exception as e:   # noqa: BLE001 — best-effort
+                    outcome = f"{type(e).__name__}"
+                if ctx is not None and ctx.sampled:
+                    add_span("cancel", t0, time.perf_counter(),
+                             process="router", lane=f"req {one}",
+                             trace_id=ctx.trace_id, request_id=one,
+                             parent_id=parent_id, replica=r.name,
+                             outcome=outcome)
         threading.Thread(target=go, name="hedge-cancel",
                          daemon=True).start()
 
@@ -548,12 +673,75 @@ class ReplicaRouter:
             time.sleep(sleep_s)
 
     # ---- the request path --------------------------------------------
+    def _rspan(self, ctx: TraceContext | None, rid: str, name: str,
+               t0: float, t1: float, **args) -> None:
+        """One router-lane span under the request's trace context — a
+        no-op for unsampled requests (the ``--trace_sample`` draw), so
+        sampling out a request costs one branch. Request-scoped spans
+        share a per-rid lane, so concurrent requests tile instead of
+        interleaving on one row."""
+        if ctx is None or not ctx.sampled:
+            return
+        add_span(name, t0, t1, process="router", lane=f"req {rid}",
+                 trace_id=ctx.trace_id, request_id=rid, **args)
+
     def _serve(self, path: str, payload: dict, rid: str,
                is_generate: bool) -> tuple[int, dict, bytes]:
         """Route one client request with fleet semantics; returns
-        ``(status, extra_headers, body_bytes)``."""
+        ``(status, extra_headers, body_bytes)``. Opens the request's
+        ROOT trace context (trace id + root span id + the
+        ``--trace_sample`` sampled flag) — every routing decision and
+        forward attempt below records a child span, and the
+        ``traceparent`` header forwards the context so replicas parent
+        their slot lanes under it."""
         self._c_requests.inc()
         t0 = time.perf_counter()
+        # the all-or-nothing endpoints skip the lock AND the draw —
+        # only a fractional trace_sample pays the (locked, shared-rng)
+        # draw per request
+        if self.trace_sample >= 1.0:
+            sampled = True
+        elif self.trace_sample <= 0.0:
+            sampled = False
+        else:
+            with self._lock:
+                sampled = self._rng.random() < self.trace_sample
+        ctx = TraceContext(new_trace_id(), new_span_id(), sampled)
+        status = None
+        try:
+            status, headers, body = self._route(
+                path, payload, rid, is_generate, ctx, t0)
+        finally:
+            t1 = time.perf_counter()
+            self._h_request.observe(t1 - t0)
+            self._rspan(ctx, rid, "request", t0, t1,
+                        span_id=ctx.span_id, path=path, status=status)
+        if status < 400 and ctx.sampled:
+            body = self._stamp_trace(body, ctx)
+        return status, headers, body
+
+    @staticmethod
+    def _stamp_trace(resp: bytes, ctx: TraceContext) -> bytes:
+        """Stamp the trace id into a successful JSON response (beside
+        ``request_ids``/``served_by``) so a client can pull the
+        stitched ``/trace/fleet`` timeline for exactly this request.
+        A ``:generate`` replica already stamped the SAME id (it comes
+        from the propagated traceparent) — skip the re-serialization
+        then; this path pays the dumps only for bodies that lack it
+        (``:predict``, older replicas)."""
+        try:
+            out = json.loads(resp)
+        except ValueError:
+            return resp
+        if not isinstance(out, dict) or "trace_id" in out:
+            return resp
+        out["trace_id"] = ctx.trace_id
+        return json.dumps(out).encode()
+
+    def _route(self, path: str, payload: dict, rid: str,
+               is_generate: bool, ctx: TraceContext,
+               t0: float) -> tuple[int, dict, bytes]:
+        """The routing loop body (see :meth:`_serve`)."""
         deadline_ms = payload.get("deadline_ms")
         # ints AND floats, the replica knob's own convention — a float
         # deadline silently ignored here would let every failover
@@ -579,7 +767,15 @@ class ReplicaRouter:
                                  f"{deadline_ms} ms deadline at the "
                                  "router (every forward attempt "
                                  "consumed it)"})
+            t_pick = time.perf_counter()
             r = self._pick(excluded, remaining_ms)
+            self._rspan(ctx, rid, "pick", t_pick, time.perf_counter(),
+                        parent_id=ctx.span_id, attempt=attempt,
+                        replica=r.name if r is not None else None,
+                        excluded=sorted(excluded),
+                        breaker_open=sorted(
+                            x.name for x in self.replicas
+                            if x.breaker.state != "closed"))
             if r is None:
                 return self._no_replica(rid, pushback, last_5xx,
                                         last_err)
@@ -608,12 +804,13 @@ class ReplicaRouter:
                     # toward hedge_after_ms
                     winner, st, hdrs, resp = self._forward_hedged(
                         r, path, data, rid, payload, excluded,
-                        timeout_s)
+                        timeout_s, ctx)
                 else:
                     winner = r
                     t_fwd = time.perf_counter()
-                    st, hdrs, resp = self._forward(r, path, data, rid,
-                                                   timeout_s)
+                    st, hdrs, resp = self._forward_traced(
+                        r, path, data, rid, timeout_s, ctx,
+                        ctx.span_id, attempt)
                     fwd_wall = time.perf_counter() - t_fwd
             except ForwardError as e:
                 last_err = e
@@ -626,7 +823,13 @@ class ReplicaRouter:
                                  f"({self.retry_budget}); last: {e}"})
                 budget -= 1
                 self._c_retries.inc()
+                t_rb = time.perf_counter()
                 self._backoff(attempt, deadline_t)
+                self._rspan(ctx, rid, "retry", t_rb,
+                            time.perf_counter(),
+                            parent_id=ctx.span_id, attempt=attempt,
+                            retry_reason="conn_error",
+                            replica=e.replica.name)
                 attempt += 1
                 continue
             finally:
@@ -649,6 +852,11 @@ class ReplicaRouter:
                     ra = 1.0
                 pushback.append((st, ra))
                 excluded.add(winner.name)
+                t_pb = time.perf_counter()
+                self._rspan(ctx, rid, "pushback_skip", t_pb, t_pb,
+                            parent_id=ctx.span_id, attempt=attempt,
+                            replica=winner.name, status=st,
+                            retry_after=ra)
                 attempt += 1
                 continue
             if st >= 500 and st != 504:
@@ -659,7 +867,13 @@ class ReplicaRouter:
                     return st, {}, resp
                 budget -= 1
                 self._c_retries.inc()
+                t_rb = time.perf_counter()
                 self._backoff(attempt, deadline_t)
+                self._rspan(ctx, rid, "retry", t_rb,
+                            time.perf_counter(),
+                            parent_id=ctx.span_id, attempt=attempt,
+                            retry_reason=f"http_{st}",
+                            replica=winner.name)
                 attempt += 1
                 continue
             # success (or a client-fault 4xx / deadline 504 that no
@@ -672,25 +886,85 @@ class ReplicaRouter:
                 resp = self._annotate(resp, winner)
             return st, {}, resp
 
+    def _forward_traced(self, r: Replica, path: str, data: bytes,
+                        rid: str, timeout_s: float,
+                        ctx: TraceContext | None, parent_id: str | None,
+                        attempt: int) -> tuple[int, dict, bytes]:
+        """One forward attempt with its own child span: a fresh span id
+        rides the ``traceparent`` header (the replica's engine spans
+        parent under it) and the attempt span — success OR failure —
+        lands on the router lane annotated with the replica and
+        outcome."""
+        child = ctx.child() if ctx is not None else None
+        t0 = time.perf_counter()
+        # launch-time point span: a complete ("X") event only exists
+        # once the attempt RESOLVES, so a wedged/cancelled attempt
+        # would otherwise be invisible in a timeline fetched while it
+        # is still in flight — the launch marker is the attempt's
+        # guaranteed-visible half
+        self._rspan(ctx, rid, "forward_launch", t0, t0,
+                    parent_id=parent_id, attempt=attempt,
+                    replica=r.name,
+                    span_id=child.span_id if child else None)
+        try:
+            st, hdrs, resp = self._forward(r, path, data, rid,
+                                           timeout_s, trace=child)
+        except ForwardError as e:
+            self._rspan(ctx, rid, "forward", t0, time.perf_counter(),
+                        parent_id=parent_id, attempt=attempt,
+                        replica=r.name,
+                        span_id=child.span_id if child else None,
+                        error=f"{e}")
+            raise
+        self._rspan(ctx, rid, "forward", t0, time.perf_counter(),
+                    parent_id=parent_id, attempt=attempt,
+                    replica=r.name,
+                    span_id=child.span_id if child else None,
+                    status=st)
+        return st, hdrs, resp
+
     def _forward_hedged(self, primary: Replica, path: str, data: bytes,
                         rid: str, payload: dict, excluded: set[str],
-                        timeout_s: float):
+                        timeout_s: float,
+                        ctx: TraceContext | None = None):
         """First-response-wins hedging: the primary gets
         ``hedge_after_ms`` to answer before ONE second attempt
         launches on a different replica (same request id). The losing
         in-flight attempt is cancelled through the replicas'
         ``POST /cancel/<rid>`` so its slot and cache blocks return to
-        the pool instead of decoding for nobody."""
+        the pool instead of decoding for nobody. The whole wave records
+        ONE "hedge" span (child of the request root) that PARENTS both
+        attempts' forward spans — a hedge race renders as two parallel
+        replica lanes under one parent in the stitched timeline."""
         results: Queue = Queue()
+        # the wave's span id exists UP FRONT so the primary's attempt
+        # span (launched before the hedge decision) already parents
+        # under it; the wave span itself is recorded at the end
+        hedge_span_id = new_span_id() if ctx is not None else None
+        t_wave = time.perf_counter()
 
-        def run(rep: Replica):
+        def run(rep: Replica, attempt: int):
             t0 = time.perf_counter()
             try:
-                out = self._forward(rep, path, data, rid, timeout_s)
+                out = self._forward_traced(rep, path, data, rid,
+                                           timeout_s, ctx,
+                                           hedge_span_id, attempt)
                 results.put((rep, out, None,
                              time.perf_counter() - t0))
             except ForwardError as e:
                 results.put((rep, None, e, 0.0))
+            except Exception as e:       # noqa: BLE001 — see below
+                # an INTERNAL failure (a bug, not a network one) must
+                # still resolve this attempt: a worker thread dying
+                # without posting would park the wave on
+                # results.get(timeout_s + 10) — a 5-minute stall for
+                # what should be an immediate error
+                log.exception("hedged forward to %s failed "
+                              "internally", rep.name)
+                results.put((rep, None,
+                             ForwardError(rep, f"internal error: "
+                                          f"{type(e).__name__}: {e}"),
+                             0.0))
 
         def continuing(st: int) -> bool:
             # statuses the outer retry loop would act on (pushback or
@@ -701,7 +975,7 @@ class ReplicaRouter:
 
         inflight = [primary]
         resolved: list[Replica] = []
-        threading.Thread(target=run, args=(primary,),
+        threading.Thread(target=run, args=(primary, 0),
                          name="fwd-primary", daemon=True).start()
         try:
             try:
@@ -713,7 +987,12 @@ class ReplicaRouter:
                     self._c_hedges.inc()
                     self._inc_outstanding(hedge, 1)
                     inflight.append(hedge)
-                    threading.Thread(target=run, args=(hedge,),
+                    t_h = time.perf_counter()
+                    self._rspan(ctx, rid, "hedge_launch", t_h, t_h,
+                                parent_id=hedge_span_id,
+                                replica=hedge.name,
+                                hedge_after_ms=self.hedge_after_ms)
+                    threading.Thread(target=run, args=(hedge, 1),
                                      name="fwd-hedge",
                                      daemon=True).start()
                 rep, out, err, wall = results.get(
@@ -752,6 +1031,8 @@ class ReplicaRouter:
                 # each attempt's OWN wall time (measured in run()) —
                 # never the hedge delay plus the primary's wait
                 rep.observe(wall)
+                if rep is not primary:
+                    self._c_hedge_wins.inc()
             # cancel ONLY a loser still in flight under a terminal
             # winner (the wave is over — _serve returns, the rid is
             # never reused); on the fallback path every attempt has
@@ -759,9 +1040,15 @@ class ReplicaRouter:
             # same-rid retry
             for loser in inflight:
                 if loser is not rep and loser not in resolved:
-                    self._cancel_on(loser, self._rids_for(rid, payload))
+                    self._cancel_on(loser, self._rids_for(rid, payload),
+                                    ctx=ctx, parent_id=hedge_span_id)
             return rep, out[0], out[1], out[2]
         finally:
+            self._rspan(ctx, rid, "hedge", t_wave, time.perf_counter(),
+                        parent_id=ctx.span_id if ctx else None,
+                        span_id=hedge_span_id,
+                        hedge_after_ms=self.hedge_after_ms,
+                        attempts=len(inflight))
             for x in inflight:
                 if x is not primary:
                     self._inc_outstanding(x, -1)
@@ -839,9 +1126,11 @@ class ReplicaRouter:
                 "retries": c("router_retries_total"),
                 "failovers": c("router_failovers_total"),
                 "hedges": c("router_hedges_total"),
+                "hedge_wins": c("router_hedge_wins_total"),
                 "breaker_opens": c("router_breaker_open_total"),
                 "probes": c("router_probes_total"),
                 "replica_healthy": c("router_replica_healthy"),
+                "incidents": c("router_incidents_total"),
             },
             "replicas": {}}
         scraped = self._scrape_replicas(
@@ -884,6 +1173,38 @@ class ReplicaRouter:
         snaps = [self.registry.snapshot()] + [
             val for ok, val in scraped.values() if ok]
         return obs_prom.render(merge_snapshots(*snaps))
+
+    def fleet_trace(self) -> dict:
+        """``GET /trace/fleet``: ONE stitched Perfetto timeline — the
+        router's own span drain on top, one process-group per replica
+        (each replica's ``GET /trace/export`` drain relabeled with its
+        fleet-side name), with per-replica clock-offset correction
+        estimated from the probe clock samples (obs/stitch.py). A dead
+        replica's export is simply absent; its router-side spans still
+        tell the story."""
+        rec = obs_trace.recorder()
+        exports: list[dict] = [{
+            "process": "router", "clock": time.perf_counter(),
+            "spans": [list(s) for s in rec.drain(process="router")],
+            "events_dropped": rec.events_dropped}]
+        offsets: dict[str, float] = {"router": 0.0}
+        samples = self.clock_samples()
+        scraped = self._scrape_replicas(
+            lambda r: self._get_json(r, "/trace/export",
+                                     timeout=self.probe_timeout_s)[1])
+        for r in self.replicas:
+            ok, val = scraped.get(r.name, (False, None))
+            if not ok or not isinstance(val, dict):
+                continue
+            # the router's replica NAME wins over the export's own
+            # process label ("serving" on a standalone server), so
+            # process groups match the routing spans' replica= args
+            val = dict(val)
+            val["process"] = r.name
+            exports.append(val)
+            offsets[r.name] = obs_stitch.estimate_offset(
+                samples.get(r.name, ()))
+        return obs_stitch.stitch(exports, offsets=offsets)
 
     def cancel(self, rid: str) -> bool:
         """``POST /cancel/<rid>`` broadcast: True when ANY replica
@@ -950,6 +1271,8 @@ class ReplicaRouter:
                     self._send(200, {},
                                router.metrics_text().encode(),
                                ctype=obs_prom.CONTENT_TYPE)
+                elif p in ("/trace/fleet", f"{scoped}/trace/fleet"):
+                    self._send_json(200, router.fleet_trace())
                 else:
                     self._send_json(404,
                                     {"error": f"unknown path {p}"})
@@ -1062,7 +1385,12 @@ class InProcessFleet:
         self.servers: list[PredictServer] = []
         reps: list[Replica] = []
         for i in range(n):
-            srv = PredictServer(export_dir, **self._server_kw).start()
+            # each replica gets its own trace-lane process label so the
+            # shared in-process ring's per-replica /trace/export drains
+            # (and incident bundle filenames) segregate
+            srv = PredictServer(export_dir,
+                                process_name=f"replica{i}",
+                                **self._server_kw).start()
             self.servers.append(srv)
             reps.append(Replica(f"http://127.0.0.1:{srv.port}",
                                 name=f"replica{i}",
@@ -1083,6 +1411,7 @@ class InProcessFleet:
         closes its breaker."""
         from .serving_http import PredictServer
         srv = PredictServer(self.export_dir,
+                            process_name=f"replica{i}",
                             **self._server_kw).start()
         self.servers[i] = srv
         rep = self.router.replicas[i]
@@ -1142,6 +1471,19 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics", choices=("on", "off"), default="on",
                     help="router registry behind GET /metrics and "
                     "/stats (replica pages merge in either way)")
+    ap.add_argument("--trace_sample", type=float, default=1.0,
+                    help="fraction of client requests opened as "
+                    "distributed traces (root span + traceparent "
+                    "propagation to the replicas); 1.0 = every "
+                    "request, 0.0 = ids only, no spans")
+    ap.add_argument("--flight_recorder", choices=("on", "off"),
+                    default="on",
+                    help="always-on span ring + auto incident bundles "
+                    "at the router (breaker_open / replica_death); "
+                    "off = ring armed on demand only")
+    ap.add_argument("--incident_dir", default=None,
+                    help="directory for router incident bundles "
+                    "(unset = none written even with the recorder on)")
     ap.add_argument("--fault_spec", default=None,
                     help="arm the fleet fault seams (router.probe / "
                     "router.forward / replica.crash) — chaos drills "
@@ -1160,7 +1502,10 @@ def main(argv=None) -> int:
         probe_interval_s=args.probe_interval_s,
         dead_after_probes=args.dead_after_probes,
         forward_timeout_s=args.forward_timeout_s,
-        metrics=args.metrics == "on")
+        metrics=args.metrics == "on",
+        trace_sample=args.trace_sample,
+        flight_recorder=args.flight_recorder == "on",
+        incident_dir=args.incident_dir)
     print(f"routing {len(router.replicas)} replica(s) on "
           f"http://{args.host}:{router.port}/v1/models/"
           f"{router.name}:generate", flush=True)

@@ -15,6 +15,14 @@ Usage::
 
     python -m distributed_tensorflow_example_tpu.utils.trace_summary \
         /tmp/trace_dir [--top 20] [--json] [--chrome out.trace.json]
+    python -m distributed_tensorflow_example_tpu.utils.trace_summary \
+        --fleet stitched.json [--json]
+
+``--fleet`` summarizes a STITCHED fleet export (the router's
+``GET /trace/fleet`` output, obs/stitch.py) offline instead of an
+xplane capture: per-process span/lane counts and busy time, the span
+vocabulary, per-trace-id request groups with their end-to-end duration
+in the router clock, and the applied per-replica clock offsets.
 
 ``--chrome`` additionally emits the capture as a chrome://tracing /
 Perfetto-loadable trace-event JSON — the direct analogue of the
@@ -259,11 +267,35 @@ def format_text(summary: dict[str, Any]) -> str:
     return "\n".join(parts)
 
 
+def format_fleet(summary: dict[str, Any]) -> str:
+    parts = []
+    for p, rec in summary["processes"].items():
+        parts.append(f"process {p!r}: {rec['spans']} span(s), "
+                     f"busy={rec['busy_ms']}ms, lanes="
+                     f"{', '.join(rec['lanes'])}")
+    offs = summary.get("clock_offsets_s") or {}
+    if offs:
+        parts.append("clock offsets (s): " + " ".join(
+            f"{k}={v}" for k, v in sorted(offs.items())))
+    parts.append(f"span names: {', '.join(summary['span_names'])}")
+    for t, rec in summary["traces"].items():
+        parts.append(f"trace {t}: {rec['spans']} span(s) across "
+                     f"{', '.join(rec['processes'])}, "
+                     f"{rec['duration_ms']}ms end-to-end")
+    return "\n".join(parts)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("trace_dir")
+    ap.add_argument("trace_dir",
+                    help="jax.profiler capture dir, or (with --fleet) "
+                         "a stitched GET /trace/fleet JSON file")
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--fleet", action="store_true",
+                    help="summarize a STITCHED fleet trace "
+                         "(obs/stitch.py output) instead of an xplane "
+                         "capture")
     ap.add_argument("--chrome", metavar="OUT_JSON", default=None,
                     help="also write a chrome://tracing / Perfetto trace "
                          "(timeline.py parity)")
@@ -271,6 +303,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="keep only the N longest events per line in the "
                          "chrome trace (dense captures)")
     args = ap.parse_args(argv)
+    if args.fleet:
+        from ..obs.stitch import summarize_fleet
+        with open(args.trace_dir) as f:
+            stitched = json.load(f)
+        s = summarize_fleet(stitched)
+        print(json.dumps(s, indent=1) if args.json
+              else format_fleet(s))
+        return 0
     spaces = _load_xspaces(args.trace_dir)     # parse once, use twice
     s = summarize(args.trace_dir, top=args.top, spaces=spaces)
     print(json.dumps(s, indent=1) if args.json else format_text(s))

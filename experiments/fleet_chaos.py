@@ -43,7 +43,21 @@ surface (kill/wedge/drain/hedge) — and asserts the round-15 contract:
                                   POST /cancel/<rid> so the victim
                                   replica's ``blocks_free`` provably
                                   returns to baseline (no leaked slot
-                                  or cache blocks).
+                                  or cache blocks). Round 17: the
+                                  router's ``GET /trace/fleet`` must
+                                  yield ONE stitched Perfetto timeline
+                                  for the request — the hedge span
+                                  parenting BOTH replica attempts,
+                                  each replica's engine spans in its
+                                  own process group (clock-corrected
+                                  into the router's window), and the
+                                  loser's "cancel" span carrying the
+                                  same request id.
+
+Round 17 also arms the wedge scenario's flight recorder: the stalled
+watchdog must AUTO-write exactly one incident bundle
+(cause=watchdog_stall) — nobody POSTs /trace/start — whose registry
+snapshot matches the wedged replica's live /metrics page.
 
 Usage::
 
@@ -56,10 +70,14 @@ tier-1 against one shared export; the CLI soak is the slow-lane twin.
 """
 
 import argparse
+import glob
 import json
 import os
+import shutil
 import sys
+import tempfile
 import threading
+import time
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -182,16 +200,23 @@ def scenario_kill_replica_mid_decode(d: str, seed: int, vocab: int):
 def scenario_wedge_one_replica_watchdog(d: str, seed: int, vocab: int):
     prompts = seeded_prompts(4, seed + 11, vocab)
     ref = reference_run(d, prompts, max_new=6)
-    # stall_after_s small so the wedge is detectable fast; the round-15
-    # idle-wait fix keeps an IDLE engine's heartbeat well inside it
-    fleet = make_fleet(d, 3, server_kw={"stall_after_s": 0.2,
-                                        "prefix_cache": False})
+    # Round 17: incident_dir arms the flight recorder's bundle writer —
+    # the stalled watchdog must auto-dump exactly one bundle without
+    # anyone POSTing /trace/start
+    incident_dir = tempfile.mkdtemp(prefix="fleet-incidents-")
+    fleet = make_fleet(d, 3, server_kw={"prefix_cache": False,
+                                        "incident_dir": incident_dir})
     # warm every replica first: the FIRST prefill/decode dispatch pays
-    # XLA compilation (hundreds of ms), which a 0.2 s watchdog would
-    # misread as a stall — the scenario is about a WEDGED dispatch,
-    # not about compile cost
+    # XLA compilation (hundreds of ms), which a tight watchdog would
+    # misread as a stall (and the flight recorder would dutifully
+    # bundle) — so the fleet warms under the default 10 s threshold,
+    # THEN the watchdog tightens to 0.2 s so the wedge below is
+    # detected fast (set_stall_after re-parks the idle wait and
+    # settles the heartbeat before the tighter threshold applies)
     for srv in fleet.servers:
         _post(srv.port, srv.name, prompts[0], max_new=2)
+    for srv in fleet.servers:
+        srv.engine.set_stall_after(0.2)
     wedged, release = threading.Event(), threading.Event()
     srv0 = fleet.servers[0]
     orig = srv0.engine.sw.decode
@@ -228,6 +253,47 @@ def scenario_wedge_one_replica_watchdog(d: str, seed: int, vocab: int):
             f"a request landed on the wedged replica: {served}"
         _wait(lambda: router_counters(fleet)["router_replica_healthy"]
               == 2, what="gauge settling at 2 healthy survivors")
+        # ---- flight recorder (round 17): the stalled watchdog must
+        # have AUTO-written exactly one incident bundle for replica0
+        # (cause=watchdog_stall, rate-limited past the probe cadence),
+        # nobody having armed tracing via /trace/start
+        _wait(lambda: glob.glob(os.path.join(incident_dir,
+                                             "incident-*.json")),
+              what="the watchdog-stall incident bundle appearing")
+        bundles = sorted(glob.glob(os.path.join(incident_dir,
+                                                "incident-*.json")))
+        assert len(bundles) == 1, \
+            f"expected exactly one bundle, got {bundles}"
+        with open(bundles[0]) as f:
+            bundle = json.load(f)
+        assert bundle["cause"] == "watchdog_stall", bundle["cause"]
+        assert bundle["process"] == "replica0", bundle["process"]
+        assert bundle["spans"], "bundle carries no span history"
+        assert bundle["health"]["status"] == "stalled", bundle["health"]
+        # the bundle's registry snapshot must MATCH the wedged
+        # replica's live /metrics page: the engine is frozen
+        # mid-dispatch, so every serving_* counter/gauge is stable
+        # between the bundle write and this scrape
+        from distributed_tensorflow_example_tpu.obs import prom
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv0.port}/metrics",
+                timeout=30) as r:
+            page = prom.parse(r.read().decode())
+        snap = bundle["registry"]
+        compared = 0
+        for name, rec in snap.items():
+            if not name.startswith("serving_") \
+                    or rec["type"] not in ("counter", "gauge"):
+                continue
+            if name.startswith("serving_incidents"):
+                # the rate-limit suppression counter keeps moving with
+                # every later probe of the still-stalled replica — the
+                # one legitimately-live metric between bundle and scrape
+                continue
+            assert page.get(name) == rec["value"], \
+                (name, rec["value"], page.get(name))
+            compared += 1
+        assert compared >= 10, f"only {compared} metrics compared"
         met = router_counters(fleet)
         release.set()
         th.join(timeout=60)
@@ -236,17 +302,25 @@ def scenario_wedge_one_replica_watchdog(d: str, seed: int, vocab: int):
               == "healthy", what="released replica re-admitted")
         return (f"wedged replica0 demoted to degraded in-probe; 4/4 "
                 f"requests served by survivors to byte parity; "
-                "released replica re-admitted as healthy", met)
+                "released replica re-admitted as healthy; watchdog "
+                "stall auto-wrote one incident bundle whose registry "
+                f"snapshot matches /metrics ({compared} metrics)", met)
     finally:
         release.set()
         fleet.close()
+        shutil.rmtree(incident_dir, ignore_errors=True)
 
 
 def scenario_breaker_trip_and_recover(d: str, seed: int, vocab: int):
     prompts = seeded_prompts(3, seed + 12, vocab)
     ref = reference_run(d, prompts, max_new=4)
+    # round 17: the ROUTER's flight recorder rides this scenario — a
+    # breaker opening and a replica death are incident causes, so the
+    # crash below must auto-write router-side bundles
+    incident_dir = tempfile.mkdtemp(prefix="router-incidents-")
     fleet = make_fleet(d, 2, breaker_threshold=2,
-                       breaker_cooldown_s=0.2)
+                       breaker_cooldown_s=0.2,
+                       incident_dir=incident_dir)
     try:
         warm = router_post(fleet, prompts[0], max_new=4)
         assert warm["generations"][0] == ref[0]
@@ -272,13 +346,24 @@ def scenario_breaker_trip_and_recover(d: str, seed: int, vocab: int):
         assert "replica0" in set(served2), \
             f"recovered replica took no traffic: {served2}"
         met = router_counters(fleet)
+        # router flight recorder: the breaker open and the replica
+        # death each wrote one bundle (distinct causes), counted in
+        # the router registry
+        bundles = sorted(os.path.basename(p) for p in glob.glob(
+            os.path.join(incident_dir, "incident-router-*.json")))
+        causes = {b.split("-")[2] for b in bundles}
+        assert {"breaker_open", "replica_death"} <= causes, bundles
+        assert met["router_incidents_total"] == len(bundles) >= 2, \
+            (met, bundles)
         return (f"crash opened replica0's breaker via probes "
                 f"(opens={met['router_breaker_open_total']}); "
                 "survivor served the wave to parity; restart + "
                 "half-open probe closed the breaker and replica0 "
-                "serves again", met)
+                "serves again; router flight recorder bundled "
+                f"{sorted(causes)}", met)
     finally:
         fleet.close()
+        shutil.rmtree(incident_dir, ignore_errors=True)
 
 
 def scenario_drain_one_replica_under_load(d: str, seed: int,
@@ -354,6 +439,30 @@ def scenario_hedge_cancels_loser(d: str, seed: int, vocab: int):
             resp["request_ids"]
         met = router_counters(fleet)
         assert met["router_hedges_total"] == 1, met
+        assert met["router_hedge_wins_total"] == 1, met
+        # ---- the stitched fleet timeline (round 17): ONE Perfetto
+        # trace in which the hedge span parents BOTH replica attempts,
+        # each replica renders as its own process group with the
+        # request's engine spans clock-corrected into the router's
+        # window, and the loser's cancellation span carries the same
+        # request id
+        trace_id = resp["trace_id"]
+        # the loser's "cancel" span is recorded by the router's
+        # fire-and-forget cancel thread AFTER its POST resolves —
+        # /trace/fleet DRAINS, so wait (non-destructively, via the
+        # in-process ring) for the span to land before the one fetch
+        from distributed_tensorflow_example_tpu.obs import \
+            trace as obs_trace
+        _wait(lambda: any(
+            s[2] == "cancel" for s in
+            obs_trace.recorder().tail(256, process="router")),
+            what="the loser's cancel span landing in the ring")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fleet.port}/trace/fleet",
+                timeout=30) as r:
+            stitched = json.loads(r.read())
+        trace_detail = _assert_stitched_hedge(stitched, trace_id,
+                                              "hedge-rid")
         release.set()
         # the loser was cancelled through POST /cancel/<rid>: its slot
         # and cache blocks must come back — NOT decode to max_new
@@ -365,10 +474,86 @@ def scenario_hedge_cancels_loser(d: str, seed: int, vocab: int):
         return (f"hedge won on replica1 (bytes to parity, same "
                 f"request id end-to-end); loser cancelled on "
                 f"replica0 — blocks_free back to {free0}, "
-                f"cancelled=1, requests_done=0", met)
+                f"cancelled=1, requests_done=0; stitched fleet trace: "
+                f"{trace_detail}", met)
     finally:
         release.set()
         fleet.close()
+
+
+def _assert_stitched_hedge(stitched: dict, trace_id: str,
+                           rid: str) -> str:
+    """Structural contract of the hedge scenario's stitched timeline
+    (the round-17 acceptance core); returns a one-line description."""
+    events = stitched["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    procs = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    by_name = {name: pid for pid, name in procs.items()}
+    assert {"router", "replica0", "replica1"} <= set(by_name), procs
+    # router lane on top: the anchor export claims the first pid
+    assert by_name["router"] < by_name["replica0"] \
+        and by_name["router"] < by_name["replica1"], procs
+    mine = [e for e in xs
+            if (e.get("args") or {}).get("trace_id") == trace_id]
+    assert mine, f"no spans for trace {trace_id}"
+
+    def named(n):
+        return [e for e in mine if e["name"] == n]
+
+    root = named("request")
+    assert len(root) == 1 and root[0]["pid"] == by_name["router"], root
+    hedge = named("hedge")
+    assert len(hedge) == 1, hedge
+    hedge_sid = hedge[0]["args"]["span_id"]
+    assert hedge[0]["args"]["parent_id"] == root[0]["args"]["span_id"]
+    # the hedge span parents BOTH replica attempts: the launch markers
+    # are the guaranteed-visible half (the wedged loser's completed
+    # "forward" span only lands once its cancellation resolves — after
+    # this fetch), and the winner's completed span must be there too
+    launches = [e for e in named("forward_launch")
+                if e["args"].get("parent_id") == hedge_sid]
+    assert len(launches) == 2, launches
+    assert {e["args"]["replica"] for e in launches} \
+        == {"replica0", "replica1"}, launches
+    done = [e for e in named("forward")
+            if e["args"].get("parent_id") == hedge_sid]
+    assert [e["args"]["replica"] for e in done] == ["replica1"], done
+    assert done[0]["args"]["status"] == 200, done
+    fwd_sids = {e["args"]["replica"]: e["args"]["span_id"]
+                for e in launches}
+    # each replica's engine spans land in ITS process group, parented
+    # under that replica's forward attempt (the propagated traceparent)
+    for rep in ("replica0", "replica1"):
+        rep_spans = [e for e in mine if e["pid"] == by_name[rep]]
+        assert rep_spans, f"no {rep} spans under trace {trace_id}"
+        assert all(e["args"].get("parent_id") == fwd_sids[rep]
+                   for e in rep_spans), (rep, rep_spans)
+        assert all(e["args"].get("request_id") == rid
+                   for e in rep_spans), (rep, rep_spans)
+        # clock correction put the replica's spans inside the router's
+        # request window (generous slack: the in-process offset
+        # estimate is bounded by probe RTT)
+        lo = root[0]["ts"] - 50_000            # µs
+        hi = root[0]["ts"] + root[0]["dur"] + 50_000
+        for e in rep_spans:
+            assert lo <= e["ts"] and e["ts"] + e["dur"] <= hi, \
+                (rep, e, root[0])
+    # the winner retired; the loser (cancelled mid-decode) did not
+    winner_names = {e["name"] for e in mine
+                    if e["pid"] == by_name["replica1"]}
+    assert "retire" in winner_names, winner_names
+    # the loser's cancellation is visible with the SAME request id
+    cancels = [e for e in named("cancel")
+               if e["args"].get("request_id") == rid]
+    assert cancels and cancels[0]["args"]["parent_id"] == hedge_sid \
+        and cancels[0]["args"]["replica"] == "replica0", cancels
+    offs = stitched["metadata"]["clock_offsets_s"]
+    assert {"replica0", "replica1"} <= set(offs), offs
+    assert all(abs(v) < 0.1 for v in offs.values()), offs
+    return (f"{len(mine)} spans across {len(procs)} process groups, "
+            f"hedge parents both attempts, cancel visible "
+            f"(offsets {offs})")
 
 
 SCENARIOS = {

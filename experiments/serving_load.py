@@ -88,6 +88,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from distributed_tensorflow_example_tpu.obs import prom as prom_mod
+
 #: documented greedy-drift gate for the int8 legs: token-level
 #: agreement with the bf16 oracle over the seeded prompt matrix must
 #: stay at or above this bound (measured 1.0 on the tiny CPU config;
@@ -161,6 +163,28 @@ def _validate_trace(tr, want_request_ids):
     missing = set(want_request_ids) - span_rids
     assert not missing, f"request ids absent from trace: {missing}"
     return len(xs)
+
+
+def saturated_histograms(parsed: dict) -> list[str]:
+    """Histogram names whose top FINITE bucket is saturated: more than
+    1% of observations overflowed into +Inf (i.e. p99 lives above the
+    largest finite bound, where percentile queries degenerate). The
+    round-17 bucket-audit gate: no default-registered histogram may
+    saturate in the --smoke run."""
+    names = {k.split("_bucket{le=", 1)[0] for k in parsed
+             if "_bucket{le=" in k}
+    bad = []
+    for h in sorted(names):
+        count = parsed.get(f"{h}_count", 0)
+        if not count:
+            continue
+        finite = [v for k, v in parsed.items()
+                  if k.startswith(f'{h}_bucket{{le="')
+                  and not k.endswith('le="+Inf"}')]
+        top_finite_cum = max(finite) if finite else 0
+        if (count - top_finite_cum) / count > 0.01:
+            bad.append(h)
+    return bad
 
 
 def _pctls(samples_ms):
@@ -274,7 +298,8 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
              prompt_len: int, mode_name: str | None = None,
              prefix_cache: bool = True, trace: bool = False,
              thread_sanitizer: bool = False,
-             spec_tokens: int = 0) -> dict:
+             spec_tokens: int = 0,
+             server_kw: dict | None = None) -> dict:
     """Drive one server mode with the closed-loop client matrix;
     returns the result row (and stashes per-request generations under
     ``_gens`` for the parity check). ``thread_sanitizer=True`` arms the
@@ -293,7 +318,8 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
     with PredictServer(export_dir, scheduler=scheduler,
                        prefix_cache=prefix_cache,
                        thread_sanitizer=thread_sanitizer,
-                       spec_tokens=spec_tokens) as srv:
+                       spec_tokens=spec_tokens,
+                       **(server_kw or {})) as srv:
         def client(ci):
             for prompt, m in matrix[ci]:
                 if scheduler == "on":
@@ -396,6 +422,10 @@ def run_mode(export_dir: str, matrix, *, scheduler: str,
         # counters from here instead of re-deriving them
         row["registry"] = {k: v for k, v in sorted(registry.items())
                            if "_bucket{" not in k}
+        # the round-17 bucket-audit observable: histograms whose top
+        # finite bucket saturated (p99 above the largest bound) —
+        # --smoke gates this list empty
+        row["saturated_histograms"] = saturated_histograms(registry)
     if trace_events is not None:
         row["trace_events"] = trace_events
     if g.get("paged"):
@@ -505,6 +535,17 @@ def run_router_mode(export_dir: str, matrix, *, replicas: int = 2,
                                             0)),
         "router_retries": int(registry.get("router_retries_total", 0)),
         "router_hedges": int(registry.get("router_hedges_total", 0)),
+        "router_hedge_wins": int(registry.get(
+            "router_hedge_wins_total", 0)),
+        "router_failovers": int(registry.get(
+            "router_failovers_total", 0)),
+        # percentile sourced from the MERGED registry's
+        # router_request_seconds histogram (not a client stopwatch) —
+        # the trajectory bench.py publishes as {key}_router_p95_ms
+        "fleet_registry_p95_ms": round(
+            prom_mod.quantile_from_parsed(
+                registry, "router_request_seconds", 0.95) * 1e3, 2),
+        "saturated_histograms": saturated_histograms(registry),
         "_gens": gens,
     }
 
@@ -718,6 +759,7 @@ def main(argv=None) -> int:
 
     rows = []
     checks = []          # (description, bool) pairs for the summary
+    extra_summary = {}   # measured (non-gate) figures for the summary
     with tempfile.TemporaryDirectory() as d:
         # the plain export: the "on" leg when quant is off, and ALWAYS
         # the scheduler-off bf16 oracle (a quant export's monolithic
@@ -887,13 +929,31 @@ def main(argv=None) -> int:
                                     mode_name="spec_on",
                                     spec_tokens=spec_k)
             sreg = spec_row["registry"]
+            # flightrec_off leg (round 17): rows[0] runs with the
+            # flight recorder's always-on ring (the default); turning
+            # it OFF must be byte- and dispatch-identical — the ring's
+            # cost is observability only — and the tps ratio is
+            # reported so a hardware window can baseline the (absence
+            # of) overhead
+            flightrec_off_row = run_mode(
+                d, matrix, scheduler="on", prompt_len=args.prompt_len,
+                mode_name="flightrec_off",
+                server_kw={"flight_recorder": False})
             # router leg (round 15): the same matrix through a
             # 2-replica fleet — greedy bytes must not depend on which
             # replica serves (or on the router being in the path)
             router_row = run_router_mode(d, matrix, replicas=2)
             rows += [paged_cold, paged_shared, shared_off, int8_row,
                      tsan_row, chaos_row, spec_off_row, spec_row,
-                     router_row]
+                     flightrec_off_row, router_row]
+            # always-on tps / recorder-off tps: ~1.0 expected (the
+            # ring's per-span cost is µs against ms-scale dispatches);
+            # reported, not gated — CPU smoke noise would make a
+            # strict bound flaky, the hardware window baselines it
+            extra_summary["flightrec_on_tps_ratio"] = round(
+                rows[0]["tokens_per_s"]
+                / flightrec_off_row["tokens_per_s"], 3) \
+                if flightrec_off_row["tokens_per_s"] else None
             checks += [
                 ("router_parity_with_single_replica",
                  router_row["_gens"] == rows[0]["_gens"]),
@@ -902,6 +962,21 @@ def main(argv=None) -> int:
                 ("router_counts_every_request",
                  router_row["router_requests"]
                  == router_row["requests"]),
+                ("router_registry_p95_positive",
+                 router_row["fleet_registry_p95_ms"] > 0),
+                # round-17 gates: tracing-always-on parity and the
+                # bucket audit (no default-registered histogram may
+                # saturate its top finite bucket under the smoke load)
+                ("flightrec_off_parity_with_on",
+                 flightrec_off_row["_gens"] == rows[0]["_gens"]),
+                ("flightrec_off_dispatch_parity",
+                 (flightrec_off_row["decode_steps"],
+                  flightrec_off_row["prefills"])
+                 == (rows[0]["decode_steps"], rows[0]["prefills"])),
+                ("no_saturated_histograms",
+                 not any(r.get("saturated_histograms")
+                         for r in [rows[0], paged_cold, paged_shared,
+                                   router_row])),
                 ("tsan_parity_with_unarmed",
                  tsan_row["_gens"] == rows[0]["_gens"]),
                 ("tsan_zero_dispatch_delta",
@@ -996,6 +1071,7 @@ def main(argv=None) -> int:
     if agreement is not None:
         summary["int8_agreement"] = agreement
         summary["int8_agreement_bound"] = INT8_MIN_AGREEMENT
+    summary.update(extra_summary)
     summary.update({name: v for name, v in checks})
     print(json.dumps(summary))
     return 0 if ok else 1

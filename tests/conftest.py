@@ -78,6 +78,47 @@ def build_telemetry_summary() -> str:
     return line
 
 
+def build_trace_summary() -> str:
+    """One-line tier-1 TRACE summary: spans the suite recorded/dropped
+    across every recorder (the always-on flight-recorder ring plus any
+    /trace/start captures), and a stitched-export self-check — two
+    fabricated per-process exports with a known clock offset must
+    stitch into loadable chrome JSON with the offset applied. A
+    failure prints as 'stitched-export FAILED' rather than hiding."""
+    import json as _json
+
+    from distributed_tensorflow_example_tpu.obs import stitch
+    from distributed_tensorflow_example_tpu.obs.trace import \
+        process_span_stats
+    stats = process_span_stats()
+    if not stats["recorded"]:
+        return ""
+    try:
+        exports = [
+            {"process": "router", "clock": 10.0,
+             "spans": [["router", "req r1", "request", 1.0, 2.0,
+                        {"trace_id": "t1"}]]},
+            {"process": "replica0", "clock": 110.0,
+             "spans": [["replica0", "slot0", "decode", 101.2, 101.8,
+                        {"trace_id": "t1"}]]},
+        ]
+        stitched = stitch.stitch(exports,
+                                 offsets={"replica0": 100.0})
+        _json.dumps(stitched)
+        xs = [e for e in stitched["traceEvents"] if e["ph"] == "X"]
+        inner, outer = sorted(xs, key=lambda e: e["dur"])[:2]
+        ok = (len(xs) == 2
+              and outer["ts"] <= inner["ts"]
+              and inner["ts"] + inner["dur"]
+              <= outer["ts"] + outer["dur"]
+              and len(stitch.summarize_fleet(stitched)["traces"]) == 1)
+        check = "stitched-export ok" if ok else "stitched-export FAILED"
+    except Exception as e:        # the banner must never mask results
+        check = f"stitched-export FAILED ({type(e).__name__})"
+    return (f"TRACE: {stats['recorded']} span(s) recorded, "
+            f"{stats['dropped']} dropped, {check}")
+
+
 def build_graftlint_summary() -> str:
     """One-line graftlint summary for the tier-1 banner: rule count,
     finding count (tier-1 requires 0 — tests/test_graftlint.py is the
@@ -114,13 +155,19 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     except Exception:           # the lint must never mask test results
         tele = ""
     try:
+        trace = build_trace_summary()
+    except Exception:
+        trace = ""
+    try:
         lint = build_graftlint_summary()
     except Exception:
         lint = ""
-    if tele or lint:
+    if tele or trace or lint:
         terminalreporter.section("TIER-1 TELEMETRY", sep="-")
         if tele:
             terminalreporter.line(tele)
+        if trace:
+            terminalreporter.line(trace)
         if lint:
             terminalreporter.line(lint)
     failed = [r.nodeid for r in terminalreporter.stats.get("failed", [])]

@@ -39,7 +39,11 @@ def _assert_ok(results):
 def test_fleet_kill_and_wedge(fleet_dir):
     """The acceptance core: killing or wedging one of three replicas
     mid-request yields ZERO client-visible failures and greedy bytes
-    identical to an undisturbed single-replica run."""
+    identical to an undisturbed single-replica run. Round 17: the
+    wedge scenario additionally asserts (internally) that the stalled
+    watchdog AUTO-wrote exactly one incident bundle
+    (cause=watchdog_stall) whose registry snapshot matches the wedged
+    replica's live /metrics page — without anyone arming tracing."""
     d, vocab = fleet_dir
     results = fleet_chaos.run_scenarios(
         ["kill_replica_mid_decode", "wedge_one_replica_watchdog"],
@@ -47,6 +51,8 @@ def test_fleet_kill_and_wedge(fleet_dir):
     _assert_ok(results)
     kill = results[0]
     assert kill["metrics"]["router_retries_total"] >= 1
+    assert "incident bundle" in results[1]["detail"]
+    assert "matches /metrics" in results[1]["detail"]
 
 
 def test_fleet_breaker_trip_and_recover(fleet_dir):
@@ -58,6 +64,9 @@ def test_fleet_breaker_trip_and_recover(fleet_dir):
         vocab=vocab)
     _assert_ok(results)
     assert results[0]["metrics"]["router_breaker_open_total"] >= 1
+    # round 17: the router's flight recorder bundled the breaker-open
+    # and replica-death incidents (rate-limited per cause)
+    assert results[0]["metrics"]["router_incidents_total"] >= 2
 
 
 def test_fleet_drain_under_load(fleet_dir):
@@ -72,12 +81,21 @@ def test_fleet_drain_under_load(fleet_dir):
 def test_fleet_hedge_cancels_loser(fleet_dir):
     """A hedged request's losing attempt is provably cancelled: the
     victim replica's blocks_free returns to baseline (asserted inside
-    the scenario) and exactly one hedge was launched."""
+    the scenario) and exactly one hedge was launched. Round 17 (the
+    tracing acceptance core, asserted structurally inside the
+    scenario via _assert_stitched_hedge): GET /trace/fleet yields ONE
+    stitched Perfetto timeline in which the router's hedge span
+    parents both replica attempts, each replica renders as its own
+    clock-corrected process group, and the loser's cancellation span
+    carries the same request id."""
     d, vocab = fleet_dir
     results = fleet_chaos.run_scenarios(
         ["hedge_cancels_loser"], seed=0, export_dir=d, vocab=vocab)
     _assert_ok(results)
     assert results[0]["metrics"]["router_hedges_total"] == 1
+    assert results[0]["metrics"]["router_hedge_wins_total"] == 1
+    assert "stitched fleet trace" in results[0]["detail"]
+    assert "hedge parents both attempts" in results[0]["detail"]
 
 
 # ---------------------------------------------------------------------------
@@ -116,6 +134,49 @@ def test_router_seams_inert_when_silent(fleet_dir):
                 "replica.crash:step=999999")
     assert plain == armed
     assert plain[1] == (0, 0, 0, 0)
+
+
+def test_flight_recorder_off_is_byte_and_dispatch_identical(fleet_dir,
+                                                            tmp_path):
+    """The armed-vs-plain parity contract (round 17): a fleet with the
+    flight recorder ON (always-on ring + incident_dir armed but QUIET —
+    no failures) serves byte-identically to --flight_recorder off,
+    with identical engine dispatch counts, and writes zero bundles.
+    Observability must only ever ADD visibility, never behavior."""
+    d, vocab = fleet_dir
+    prompts = serving_chaos.seeded_prompts(3, 23, vocab)
+    inc_dir = str(tmp_path / "incidents")
+
+    def run(server_kw, router_kw):
+        fleet = fleet_chaos.make_fleet(d, 2, server_kw=server_kw,
+                                       **router_kw)
+        try:
+            # SEQUENTIAL requests: the idle least-outstanding
+            # tie-break routes deterministically, so per-replica
+            # dispatch counts are comparable across the two runs
+            # (a concurrent wave's batching composition is
+            # timing-dependent)
+            outs = [fleet_chaos.router_post(
+                fleet, p, max_new=3)["generations"][0]
+                for p in prompts]
+            dispatch = []
+            for i in range(2):
+                g = fleet_chaos.replica_stats(fleet, i)
+                dispatch.append((g["decode_steps"], g["prefills"],
+                                 g["requests_done"]))
+            return outs, dispatch
+        finally:
+            fleet.close()
+
+    armed = run({"incident_dir": inc_dir},
+                {"incident_dir": inc_dir})
+    plain = run({"flight_recorder": False},
+                {"flight_recorder": False})
+    assert armed[0] == plain[0], "flight recorder changed greedy bytes"
+    assert armed[1] == plain[1], \
+        "flight recorder changed dispatch counts"
+    assert not (os.path.isdir(inc_dir) and os.listdir(inc_dir)), \
+        "a quiet run wrote incident bundles"
 
 
 @pytest.mark.slow

@@ -296,6 +296,74 @@ class E:
 
 
 # ---------------------------------------------------------------------------
+# TRC01 — span-name literals must resolve against docs/span_names.txt
+# ---------------------------------------------------------------------------
+
+def test_trc01_true_positive_and_guards():
+    """A span() literal NOT in docs/span_names.txt is a finding; a
+    declared one is clean; attribute calls like a regex match's
+    .span(1) and variable span names are out of scope (heuristic,
+    documented)."""
+    src = '''
+from distributed_tensorflow_example_tpu.obs.trace import add_span, span
+
+def work(m, name):
+    with span("prefill", lane="slot0"):        # declared: clean
+        pass
+    with span("prefil", lane="slot0"):         # TYPO: finding
+        pass
+    add_span("queue_wait", 0.0, 1.0)           # declared: clean
+    with span(name):                           # variable: skipped
+        pass
+    return m.span(1)                           # regex match: skipped
+'''
+    r = lint_source(src, rules=["TRC01"])
+    assert len(r.findings) == 1, [f.render() for f in r.findings]
+    assert "'prefil'" in r.findings[0].message
+
+
+def test_trc01_sees_span_name_kwarg_default_and_rspan():
+    """The engine's ``span_name`` parameter defaults / keyword
+    arguments and the router's ``_rspan`` wrapper are span-recording
+    entry points too — their literals must resolve."""
+    src = '''
+from distributed_tensorflow_example_tpu.obs.trace import span
+
+def _dispatch(feats, span_name: str = "decode_step"):
+    with span(span_name):
+        pass
+
+def caller(self, ctx, rid):
+    _dispatch({}, span_name="verify_stepz")     # TYPO: finding
+    self._rspan(ctx, rid, "hedgge", 0.0, 1.0)   # TYPO: finding
+    self._rspan(ctx, rid, "hedge", 0.0, 1.0)    # declared: clean
+'''
+    r = lint_source(src, rules=["TRC01"])
+    flagged = {f.message.split("'")[1] for f in r.findings}
+    assert flagged == {"verify_stepz", "hedgge"}, (
+        [f.render() for f in r.findings])
+
+
+def test_trc01_span_inventory_drift_guard():
+    """docs/span_names.txt is pinned BOTH ways (the known_failures.txt
+    pattern): every statically-visible span-name literal in the lint
+    surface must be declared (TRC01 enforces that side on every run),
+    and every declared name must still be USED somewhere — a stale
+    inventory line is as loud as an undeclared span."""
+    from tools.graftlint import load_files
+    from tools.graftlint.rules import (collect_span_literals,
+                                       load_span_inventory)
+    files, errors = load_files()
+    assert not errors
+    used = set(collect_span_literals(files))
+    declared = load_span_inventory()
+    assert used == declared, (
+        f"span inventory drift — undeclared: {sorted(used - declared)}"
+        f", stale: {sorted(declared - used)} (update "
+        "docs/span_names.txt alongside the span() call sites)")
+
+
+# ---------------------------------------------------------------------------
 # CFG01 — declared-but-never-read config fields / CLI flags
 # ---------------------------------------------------------------------------
 

@@ -15,8 +15,8 @@ from distributed_tensorflow_example_tpu.obs import prom
 from distributed_tensorflow_example_tpu.obs.registry import (
     Registry, all_registries, merge_snapshots)
 from distributed_tensorflow_example_tpu.obs.trace import (
-    ChromeTraceWriter, TraceRecorder, add_span, recorder, set_recorder,
-    span)
+    ChromeTraceWriter, TraceContext, TraceRecorder, add_span,
+    arm_always_on, parse_traceparent, recorder, set_recorder, span)
 
 
 @pytest.fixture
@@ -277,6 +277,168 @@ def test_recorder_restart_clears_previous_capture():
     rec.stop()
     xs = [e for e in rec.to_chrome()["traceEvents"] if e["ph"] == "X"]
     assert [e["name"] for e in xs] == ["new"]
+
+
+# ------------------------------------------------- distributed tracing
+def test_traceparent_roundtrip_and_malformed():
+    ctx = TraceContext("ab" * 16, "cd" * 8, sampled=True)
+    assert ctx.to_traceparent() == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    back = parse_traceparent(ctx.to_traceparent())
+    assert back == ctx
+    off = TraceContext("ab" * 16, "cd" * 8, sampled=False)
+    assert parse_traceparent(off.to_traceparent()).sampled is False
+    # malformed values degrade to None, never raise (propagation is
+    # best-effort — a garbled header must not 4xx a request)
+    for bad in (None, "", "00-zz-cd-01", "junk", "00-" + "a" * 32,
+                "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",
+                "00-" + "ab" * 16 + "-" + "0" * 16 + "-01"):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_trace_context_child_and_span_args():
+    ctx = TraceContext("ab" * 16, "cd" * 8, sampled=True)
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id and len(child.span_id) == 16
+    assert ctx.span_args() == {"trace_id": ctx.trace_id,
+                               "parent_id": ctx.span_id}
+    # unsampled: ids still propagate, but receivers attach nothing
+    assert TraceContext("ab" * 16, "cd" * 8,
+                        sampled=False).span_args() == {}
+
+
+def test_recorder_drain_per_process_and_tail():
+    """drain(process=...) removes ONLY that label's spans (the shared
+    in-process-fleet ring contract); tail() is non-destructive."""
+    rec = TraceRecorder()
+    rec.start()
+    t = time.perf_counter()
+    rec.add("replica0", "slot0", "prefill", t, t + 1, None)
+    rec.add("replica1", "slot0", "prefill", t + 2, t + 3, None)
+    rec.add("replica0", "slot0", "decode", t + 4, t + 5, None)
+    assert [s[2] for s in rec.tail(10, process="replica0")] \
+        == ["prefill", "decode"]
+    assert [s[2] for s in rec.tail(1, process="replica0")] == ["decode"]
+    drained = rec.drain(process="replica0")
+    assert [s[0] for s in drained] == ["replica0", "replica0"]
+    # replica1's span survived the other replica's export
+    assert [s[0] for s in rec.drain()] == ["replica1"]
+    assert rec.drain() == []
+
+
+def test_arm_always_on_never_clears_an_active_capture():
+    old = recorder()
+    try:
+        rec = set_recorder(TraceRecorder())
+        rec.start()
+        t = time.perf_counter()
+        rec.add("serving", "main", "prefill", t, t + 1, None)
+        # a second server arming always-on must neither clear nor
+        # resize the live capture
+        assert arm_always_on(max_events=128) is rec
+        assert rec.spans_recorded == 1 and rec.max_events != 128
+        rec.stop()
+        # disarmed: arming starts recording again
+        arm_always_on()
+        assert recorder().enabled
+    finally:
+        set_recorder(old)
+
+
+def test_armed_recorder_overhead_within_budget(fresh_recorder):
+    """The sampled-ON twin of the disabled-path guard: with the
+    always-on flight-recorder ring armed, span()/add_span() must stay
+    under the same 2 µs/call budget (measured ~1.7 µs here — one lock
+    + deque append; best-of-5 loops reject scheduler noise)."""
+    rec = fresh_recorder
+    rec.start()
+    n = 5000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span("prefill", lane="slot0", request_id="r"):
+                pass
+            add_span("decode", 0.0, 1.0, lane="slot0")
+        best = min(best, time.perf_counter() - t0)
+    assert rec.spans_recorded == 5 * 2 * n
+    assert best / (2 * n) < 2e-6, \
+        f"armed span path too slow: {best / (2 * n) * 1e6:.2f} µs/call"
+
+
+# ------------------------------------------------- prom round-trip
+def test_parse_snapshot_render_roundtrip_is_exact():
+    """parse_snapshot(render(s)) == s EXACTLY — counters, gauges,
+    histograms with +Inf overflow, float values, and escaped help text
+    (backslashes + newlines) all round-trip; plus every metric gets a
+    # TYPE line and every helped metric a # HELP line."""
+    reg = Registry()
+    reg.counter("a_total", "plain help").inc(7)
+    reg.counter("f_total", "").inc(2.5)               # float counter
+    reg.gauge("g", "multi\nline \\ help").set(-3.25)
+    h = reg.histogram("lat_seconds", "hist\nhelp", buckets=(0.5, 1.0))
+    for v in (0.1, 0.7, 99.0):                        # +Inf overflow
+        h.observe(v)
+    reg.histogram("empty_seconds", "never observed", buckets=(1.0,))
+    snap = reg.snapshot()
+    text = prom.render(snap)
+    assert prom.parse_snapshot(text) == snap
+    lines = text.splitlines()
+    for name in snap:
+        assert any(ln.startswith(f"# TYPE {name} ") for ln in lines)
+    for name, rec in snap.items():
+        if rec["help"]:
+            assert any(ln.startswith(f"# HELP {name} ")
+                       for ln in lines)
+    # and the escape itself is lossless through a SECOND round trip
+    again = prom.render(prom.parse_snapshot(text))
+    assert again == text
+
+
+def test_parse_snapshot_roundtrip_property_style():
+    """Seeded randomized round-trip over many registry shapes — the
+    completeness contract, not one hand-picked example."""
+    import random
+    rng = random.Random(17)
+    for case in range(25):
+        reg = Registry()
+        for i in range(rng.randint(1, 5)):
+            kind = rng.choice(("counter", "gauge", "histogram"))
+            help_text = rng.choice(
+                ("", "plain", "with \\ backslash", "two\nlines"))
+            name = f"m{case}_{i}_{kind}"
+            if kind == "counter":
+                c = reg.counter(name + "_total", help_text)
+                for _ in range(rng.randint(0, 4)):
+                    c.inc(rng.choice((1, 2, 0.5)))
+            elif kind == "gauge":
+                reg.gauge(name, help_text).set(
+                    rng.choice((0, -1, 3.5, 1e9)))
+            else:
+                bounds = sorted(rng.sample(
+                    (0.001, 0.01, 0.1, 1.0, 10.0, 100.0),
+                    rng.randint(1, 4)))
+                hh = reg.histogram(name + "_seconds", help_text,
+                                   buckets=bounds)
+                for _ in range(rng.randint(0, 6)):
+                    hh.observe(rng.uniform(0, 200))
+        snap = reg.snapshot()
+        assert prom.parse_snapshot(prom.render(snap)) == snap, case
+
+
+def test_quantile_from_parsed():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05,) * 5 + (0.5,) * 4 + (5.0,):
+        h.observe(v)
+    parsed = prom.parse(prom.render(reg.snapshot()))
+    p50 = prom.quantile_from_parsed(parsed, "lat_seconds", 0.5)
+    assert 0.0 < p50 <= 0.1
+    p90 = prom.quantile_from_parsed(parsed, "lat_seconds", 0.9)
+    assert 0.1 < p90 <= 1.0
+    assert prom.quantile_from_parsed(parsed, "absent", 0.5) == 0.0
+    with pytest.raises(ValueError, match="q must be"):
+        prom.quantile_from_parsed(parsed, "lat_seconds", 1.5)
 
 
 # ----------------------------------------------------- training telemetry

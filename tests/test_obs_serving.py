@@ -296,13 +296,16 @@ def test_request_log_jsonl_events(paged_dir, tmp_path):
 
 def test_disabled_telemetry_fast_paths(paged_dir):
     """Telemetry off must be FREE: a full engine run with tracing
-    disarmed records zero spans, and a metrics=False server's registry
-    never moves while requests still serve correctly."""
+    disarmed (flight_recorder=False — the round-17 always-on ring is
+    the DEFAULT, so turning everything off is now an explicit choice)
+    records zero spans, and a metrics=False server's registry never
+    moves while requests still serve correctly."""
     rec = recorder()
+    rec.stop()        # an earlier always-on server may have armed it
     before = rec.spans_recorded
     assert not rec.enabled
     with PredictServer(paged_dir, scheduler="on",
-                       metrics=False) as srv:
+                       metrics=False, flight_recorder=False) as srv:
         out = _post(srv.port, f"/v1/models/{srv.name}:generate",
                     {"inputs": {"input_ids": [[1, 2, 3, 4]]}})
         assert len(out["generations"][0]) == MAX_NEW

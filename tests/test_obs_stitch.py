@@ -1,0 +1,251 @@
+"""obs/stitch.py + obs/flightrec.py unit tests: NTP-style clock-offset
+estimation and the fleet stitcher with INJECTED clocks (no wall-clock
+sleeps), and the flight recorder's bundle format, atomicity, rate
+limiting (injected clock), and counters.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import stitch
+from distributed_tensorflow_example_tpu.obs.flightrec import (
+    FlightRecorder, config_fingerprint)
+from distributed_tensorflow_example_tpu.obs.registry import Registry
+from distributed_tensorflow_example_tpu.obs.trace import (
+    TraceRecorder, recorder, set_recorder)
+
+
+@pytest.fixture
+def fresh_recorder():
+    old = recorder()
+    rec = set_recorder(TraceRecorder())
+    yield rec
+    set_recorder(old)
+
+
+# ------------------------------------------------------ offset estimate
+def test_estimate_offset_median_from_injected_clocks():
+    """offset = remote_now - probe midpoint; the MEDIAN over samples
+    rejects the occasional slow (asymmetric-delay) probe."""
+    # remote clock runs 100 s ahead; probes take 2 ms each
+    samples = [(t, t + 0.002, (t + 0.001) + 100.0)
+               for t in (5.0, 6.0, 7.0, 8.0)]
+    assert stitch.estimate_offset(samples) == pytest.approx(100.0)
+    # one pathological probe (5 s stall AFTER the remote stamped its
+    # clock — worst-case asymmetry) must not drag the estimate
+    samples.append((9.0, 14.0, 9.001 + 100.0))
+    assert stitch.estimate_offset(samples) == pytest.approx(100.0,
+                                                            abs=1e-6)
+    assert stitch.estimate_offset([]) == 0.0
+
+
+def test_estimate_offset_negative_and_even_count():
+    samples = [(t, t + 0.01, (t + 0.005) - 40.0) for t in (1.0, 2.0)]
+    assert stitch.estimate_offset(samples) == pytest.approx(-40.0)
+
+
+# -------------------------------------------------------------- stitch
+def _export(process, spans, clock=0.0):
+    return {"process": process, "clock": clock,
+            "spans": [list(s) for s in spans], "events_dropped": 0}
+
+
+def test_stitch_corrects_clocks_and_orders_processes():
+    """Two processes whose clocks differ by exactly +100 s: after
+    correction the replica's span nests inside the router's request
+    window, the router claims the FIRST pid (top lane), and the
+    metadata records the applied offsets."""
+    router = _export("router", [
+        ("router", "req r1", "request", 10.0, 11.0,
+         {"trace_id": "t1", "span_id": "root"})])
+    replica = _export("replica0", [
+        ("replica0", "slot0", "decode", 110.2, 110.9,
+         {"trace_id": "t1", "parent_id": "fwd"})])
+    out = stitch.stitch([router, replica],
+                        offsets={"router": 0.0, "replica0": 100.0})
+    assert json.loads(json.dumps(out))
+    procs = {e["pid"]: e["args"]["name"]
+             for e in out["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert procs[1] == "router" and procs[2] == "replica0"
+    xs = {e["name"]: e for e in out["traceEvents"] if e["ph"] == "X"}
+    root, dec = xs["request"], xs["decode"]
+    assert root["ts"] == 0.0                       # anchor
+    assert root["ts"] <= dec["ts"]
+    assert dec["ts"] + dec["dur"] <= root["ts"] + root["dur"]
+    assert dec["args"]["parent_id"] == "fwd"       # args untouched
+    assert out["metadata"]["clock_offsets_s"]["replica0"] == 100.0
+    assert out["metadata"]["processes"] == ["router", "replica0"]
+
+
+def test_spans_for_trace_and_summarize_fleet():
+    router = _export("router", [
+        ("router", "req r1", "request", 0.0, 1.0,
+         {"trace_id": "t1"}),
+        ("router", "req r2", "request", 0.5, 0.9,
+         {"trace_id": "t2"})])
+    replica = _export("replica0", [
+        ("replica0", "slot0", "decode", 0.2, 0.8,
+         {"trace_id": "t1"}),
+        ("replica0", "scheduler", "decode_step", 0.2, 0.3, None)])
+    out = stitch.stitch([router, replica])
+    assert {e["args"]["trace_id"]
+            for e in stitch.spans_for_trace(out, "t1")} == {"t1"}
+    assert len(stitch.spans_for_trace(out, "t1")) == 2
+    s = stitch.summarize_fleet(out)
+    assert set(s["processes"]) == {"router", "replica0"}
+    assert s["processes"]["replica0"]["spans"] == 2
+    assert "decode_step" in s["span_names"]
+    assert set(s["traces"]) == {"t1", "t2"}
+    assert s["traces"]["t1"]["processes"] == ["replica0", "router"]
+    assert s["traces"]["t1"]["duration_ms"] == pytest.approx(1000.0)
+
+
+# ------------------------------------------------- trace_summary --fleet
+def test_trace_summary_fleet_mode(tmp_path, capsys):
+    """``trace_summary --fleet stitched.json`` summarizes a stitched
+    export offline — no TF/xplane dependency, text and --json forms."""
+    from distributed_tensorflow_example_tpu.utils.trace_summary import \
+        main
+    out = stitch.stitch([
+        _export("router", [("router", "req r1", "request", 0.0, 1.0,
+                            {"trace_id": "t1"})]),
+        _export("replica0", [("replica0", "slot0", "decode", 100.3,
+                              100.7, {"trace_id": "t1"})]),
+    ], offsets={"replica0": 100.0})
+    path = tmp_path / "stitched.json"
+    path.write_text(json.dumps(out))
+    assert main(["--fleet", str(path)]) == 0
+    text = capsys.readouterr().out
+    assert "process 'router'" in text and "process 'replica0'" in text
+    assert "trace t1" in text and "replica0=100.0" in text
+    assert main(["--fleet", str(path), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["traces"]["t1"]["spans"] == 2
+    assert s["clock_offsets_s"]["replica0"] == 100.0
+
+
+# ------------------------------------------------------ flight recorder
+def test_flightrec_bundle_contents_and_counters(tmp_path,
+                                                fresh_recorder):
+    """One incident -> one atomically-complete JSON bundle carrying the
+    span tail (non-destructive), the registry snapshot, config
+    fingerprint, and caller context; the counters ride a NAMESPACED
+    registry like the production ones."""
+    rec = fresh_recorder
+    rec.start()
+    rec.add("serving", "slot0", "prefill", 1.0, 2.0, {"request_id": "r"})
+    rec.add("other", "lane", "decode", 1.0, 2.0, None)
+    reg = Registry(namespace="serving")
+    c = reg.counter("serving_incidents_total", "bundles")
+    supp = reg.counter("serving_incidents_suppressed_total",
+                       "suppressed")
+    log_path = tmp_path / "req.jsonl"
+    log_path.write_text("line1\nline2\n")
+    fr = FlightRecorder(str(tmp_path / "inc"), process="serving",
+                        snapshot_fn=reg.snapshot,
+                        config={"max_queue": 64},
+                        request_log_path=str(log_path),
+                        counter=c, suppressed_counter=supp)
+    path = fr.incident("watchdog_stall", detail="hb 1.2s",
+                       extra={"health": {"status": "stalled"}})
+    assert path and os.path.exists(path)
+    assert os.path.basename(path).startswith(
+        "incident-serving-watchdog_stall-")
+    assert not [p for p in os.listdir(tmp_path / "inc")
+                if p.endswith(".tmp")]
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["cause"] == "watchdog_stall"
+    assert bundle["detail"] == "hb 1.2s"
+    assert bundle["health"] == {"status": "stalled"}
+    # only THIS process's spans, and non-destructively
+    assert [s[2] for s in bundle["spans"]] == ["prefill"]
+    assert rec.spans_recorded == 2 and len(rec.drain()) == 2
+    assert bundle["config"] == {"max_queue": 64}
+    assert bundle["config_fingerprint"] == config_fingerprint(
+        {"max_queue": 64})
+    assert bundle["request_log_tail"] == ["line1", "line2"]
+    # the counter advanced BEFORE the snapshot landed in the bundle,
+    # so bundle and live page agree
+    assert bundle["registry"]["serving_incidents_total"]["value"] == 1
+    assert c.value == 1 and supp.value == 0
+    # a same-cause repeat inside the window is suppressed AND counted
+    assert fr.incident("watchdog_stall") is None
+    assert c.value == 1 and supp.value == 1
+
+
+def test_flightrec_rate_limit_per_cause_injected_clock(tmp_path):
+    now = [0.0]
+    fr = FlightRecorder(str(tmp_path), min_interval_s=30.0,
+                        clock=lambda: now[0])
+    reg = Registry(namespace="router")
+    fr._counter = reg.counter("router_incidents_total")
+    fr._suppressed = reg.counter("router_incidents_suppressed_total")
+    assert fr.incident("watchdog_stall") is not None
+    assert fr.incident("watchdog_stall") is None        # suppressed
+    assert fr.incident("breaker_open") is not None      # other cause
+    now[0] = 31.0
+    assert fr.incident("watchdog_stall") is not None    # window over
+    names = sorted(os.listdir(tmp_path))
+    assert len(names) == 3, names
+    assert fr._counter.value == 3 and fr._suppressed.value == 1
+
+
+def test_flightrec_failed_write_rolls_back_rate_limit(tmp_path):
+    """Review regression: a failed bundle write (disk full, unwritable
+    dir) must not suppress the cause for min_interval_s — nothing was
+    captured, so the NEXT occurrence retries immediately."""
+    fr = FlightRecorder(str(tmp_path), min_interval_s=3600.0)
+    real_write = fr._write
+    boom = [True]
+
+    def flaky_write(*a, **kw):
+        if boom[0]:
+            boom[0] = False
+            raise OSError("disk full")
+        return real_write(*a, **kw)
+
+    fr._write = flaky_write
+    assert fr.incident("watchdog_stall") is None        # write failed
+    path = fr.incident("watchdog_stall")                # retries NOW
+    assert path is not None and os.path.exists(path)
+    # and the limit applies again after the successful write
+    assert fr.incident("watchdog_stall") is None
+
+
+def test_flightrec_snapshot_failure_degrades_not_raises(tmp_path):
+    def bad_snapshot():
+        raise RuntimeError("registry gone")
+
+    fr = FlightRecorder(str(tmp_path), snapshot_fn=bad_snapshot)
+    path = fr.incident("engine_fatal_rebuild", detail="x")
+    with open(path) as f:
+        bundle = json.load(f)
+    assert "registry" not in bundle
+    assert "RuntimeError" in bundle["registry_error"]
+
+
+def test_flightrec_is_thread_safe_one_bundle_under_racing_probes(
+        tmp_path):
+    """N concurrent probe threads reporting the same cause: exactly one
+    bundle (the production shape — a stalled replica is probed from a
+    fast loop)."""
+    fr = FlightRecorder(str(tmp_path), min_interval_s=3600.0)
+    paths = []
+
+    def probe():
+        p = fr.incident("watchdog_stall")
+        if p:
+            paths.append(p)
+
+    threads = [threading.Thread(target=probe) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(paths) == 1
+    assert len(os.listdir(tmp_path)) == 1

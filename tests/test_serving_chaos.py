@@ -343,7 +343,7 @@ def test_queue_full_status_and_headers_agree_across_paths(chaos_dir,
     export_model(m, params, extras, dp, platforms=("cpu",))
     feats = serving_signature(m.dummy_batch(4))
 
-    def full(payload, request_id=None):
+    def full(payload, request_id=None, trace=None):
         raise QueueFullError("full", retry_after=2.6)
 
     seen = {}

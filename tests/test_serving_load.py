@@ -29,7 +29,7 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
                           "paged_shared", "shared_off", "int8_on",
                           "tsan_on", "chaos_on", "spec_off", "spec_on",
-                          "router_on"}
+                          "flightrec_off", "router_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -108,6 +108,18 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert router["tokens_per_s"] > 0 and router["latency_p95_ms"] > 0
     assert router["router_requests"] == router["requests"] == 4
     assert sum(router["served_by"].values()) == 4
+    # round-17 gates: the always-on flight-recorder ring costs zero
+    # behavior (byte + dispatch parity with --flight_recorder off),
+    # the merged-registry router p95 is real, and the bucket audit
+    # holds (no histogram saturates its top finite bucket)
+    assert s["flightrec_off_parity_with_on"] is True
+    assert s["flightrec_off_dispatch_parity"] is True
+    assert s["no_saturated_histograms"] is True
+    assert s["router_registry_p95_positive"] is True
+    assert s["flightrec_on_tps_ratio"] > 0
+    assert router["fleet_registry_p95_ms"] > 0
+    assert router["saturated_histograms"] == []
+    assert not modes["flightrec_off"]["errors"]
 
 
 def test_smoke_rejects_thread_sanitizer_flag(capsys):
@@ -151,6 +163,15 @@ def test_bench_serving_row_publishes_keys():
     assert row["serving_spec_errors"] == 0
     assert 0.0 <= row["serving_accept_rate"] <= 1.0
     assert row["serving_spec_tokens_per_dispatch"] > 0
+    # round-17 fleet columns (gpt_router_p95_ms /
+    # gpt_router_failover_total / gpt_router_hedge_win_rate after key
+    # prefixing) — the serving-fleet BENCH trajectory's first rows,
+    # sourced from the MERGED registry
+    assert row["router_tps"] > 0
+    assert row["router_p95_ms"] > 0
+    assert row["router_errors"] == 0
+    assert row["router_failover_total"] >= 0
+    assert 0.0 <= row["router_hedge_win_rate"] <= 1.0
 
 
 @pytest.mark.slow

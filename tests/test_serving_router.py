@@ -286,7 +286,7 @@ def test_half_open_trial_pushback_releases_probe_slot():
         rep = reps[0]
         rep.breaker = CircuitBreaker(threshold=1, cooldown_s=0.0)
         rep.breaker.record_failure()              # open, cooldown 0
-        router._forward = lambda r, path, body, rid, t: (
+        router._forward = lambda r, path, body, rid, t, trace=None: (
             429, {"Retry-After": "2"}, b'{"error": "full"}')
         st, headers, _ = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
                                        "rid-po", True)
@@ -294,7 +294,7 @@ def test_half_open_trial_pushback_releases_probe_slot():
         # the trial released the slot AND counted as responsiveness:
         # the breaker is closed again, not wedged half-open
         assert rep.breaker.state == "closed"
-        router._forward = lambda r, path, body, rid, t: (
+        router._forward = lambda r, path, body, rid, t, trace=None: (
             200, {}, b'{"generations": [[9]]}')
         st, _, body = router._serve(GEN_PATH, dict(GEN_PAYLOAD),
                                     "rid-po2", True)
@@ -314,7 +314,7 @@ def test_hedged_double_failure_excludes_both_replicas():
     try:
         calls = []
 
-        def fake_forward(r, path, body, rid, timeout_s):
+        def fake_forward(r, path, body, rid, timeout_s, trace=None):
             calls.append(r.name)
             if r.name == "r0":
                 time.sleep(0.05)
@@ -348,7 +348,7 @@ def test_float_deadline_ms_honored_and_decremented_on_failover():
     try:
         seen = []
 
-        def fake_forward(r, path, body, rid, timeout_s):
+        def fake_forward(r, path, body, rid, timeout_s, trace=None):
             seen.append(json.loads(body)["deadline_ms"])
             if len(seen) == 1:
                 time.sleep(0.05)
@@ -378,9 +378,10 @@ def test_hedge_pushback_waits_for_sibling_never_cancels():
     router, reps = _bare_router(2, hedge_after_ms=10)
     try:
         cancels, calls = [], []
-        router._cancel_on = lambda r, rids: cancels.append(r.name)
+        router._cancel_on = lambda r, rids, ctx=None, parent_id=None: \
+            cancels.append(r.name)
 
-        def fake_forward(r, path, body, rid, timeout_s):
+        def fake_forward(r, path, body, rid, timeout_s, trace=None):
             calls.append(r.name)
             if r.name == "r0":
                 time.sleep(0.05)
@@ -411,7 +412,7 @@ def test_hedge_winner_observes_its_own_wall_time():
     hedge_after_ms and mis-steer the deadline-aware skip."""
     router, reps = _bare_router(2, hedge_after_ms=20)
     try:
-        def fake_forward(r, path, body, rid, timeout_s):
+        def fake_forward(r, path, body, rid, timeout_s, trace=None):
             time.sleep(0.3 if r.name == "r0" else 0.01)
             return 200, {}, b'{"generations": [[1]]}'
 
@@ -489,10 +490,10 @@ def test_pushback_propagates_with_min_retry_after(fleet_dir):
         QueueFullError
     d, _ = fleet_dir
     with InProcessFleet(d, 2, probe_interval_s=0.05) as fleet:
-        def full_26(payload, request_id=None):
+        def full_26(payload, request_id=None, trace=None):
             raise QueueFullError("full", retry_after=2.6)
 
-        def full_71(payload, request_id=None):
+        def full_71(payload, request_id=None, trace=None):
             raise QueueFullError("full", retry_after=7.1)
 
         fleet.servers[0].generate = full_26

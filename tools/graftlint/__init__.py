@@ -1,7 +1,7 @@
 """graftlint: invariant-checking static analysis for this repo.
 
 ``python -m tools.graftlint [--changed] [--json] [paths...]`` runs the
-rule set (JIT01, DON01, THR01, OBS01, CFG01 — see
+rule set (JIT01, DON01, THR01, OBS01, TRC01, CFG01 — see
 :mod:`tools.graftlint.rules`) over the package and experiments; tier-1
 requires a clean run (tests/test_graftlint.py).
 """

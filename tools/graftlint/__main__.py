@@ -18,7 +18,8 @@ def main(argv=None) -> int:
         description=("invariant-checking static analysis: JIT01 (jit "
                      "purity), DON01 (train-step donation), THR01 "
                      "(scheduler thread ownership), OBS01 (registered "
-                     "metric names), CFG01 (dead config knobs). "
+                     "metric names), TRC01 (declared span names), "
+                     "CFG01 (dead config knobs). "
                      "Suppress one line with '# graftlint: "
                      "disable=RULE' plus a reason comment."))
     ap.add_argument("paths", nargs="*",

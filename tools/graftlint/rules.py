@@ -24,6 +24,13 @@ OBS01  every metric-name string literal must resolve to a registered
        the tier-1 dead-counter lint: that one catches registered-but-
        never-touched, this one catches a TYPO'D name (e.g. in a
        snapshot lookup) the runtime lint structurally cannot see.
+TRC01  every span-name literal passed to ``span()``/``add_span()``
+       must resolve against the declared span-name inventory
+       (``docs/span_names.txt``, drift-guarded by
+       tests/test_graftlint.py the way known_failures.txt is) — the
+       fleet stitcher and the trace summaries group lanes by span
+       NAME, so a typo'd name silently drops a span from every
+       grouped view; OBS01's sibling for the trace vocabulary.
 CFG01  config dataclass fields (config.py) and argparse ``--flags``
        declared but never read anywhere — a silently ignored knob is
        worse than an error (the repo's own config-validation mantra).
@@ -37,10 +44,11 @@ comment, never by weakening the rule silently.
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Iterable, Sequence
 
-from .engine import Finding, SourceFile
+from .engine import REPO_ROOT, Finding, SourceFile
 
 # ---------------------------------------------------------------------------
 # shared AST helpers
@@ -636,6 +644,107 @@ class Obs01(Rule):
 
 
 # ---------------------------------------------------------------------------
+# TRC01 — span-name literals must resolve against docs/span_names.txt
+# ---------------------------------------------------------------------------
+
+#: the declared span-name inventory (drift-guarded by
+#: tests/test_graftlint.py exactly like docs/known_failures.txt)
+SPAN_NAMES_PATH = os.path.join(REPO_ROOT, "docs", "span_names.txt")
+
+#: the span-recording entry points; BARE-name calls only — attribute
+#: calls like a regex match's ``m.span(1)`` are a different function
+_SPAN_FNS = frozenset({"span", "add_span"})
+
+
+def load_span_inventory(path: str = SPAN_NAMES_PATH) -> set[str]:
+    """docs/span_names.txt: one span name per line, '#' comments
+    skipped — THE parser, shared with the tier-1 drift guard."""
+    with open(path, encoding="utf-8") as f:
+        return {ln.strip() for ln in f
+                if ln.strip() and not ln.startswith("#")}
+
+
+def collect_span_literals(files: Iterable[SourceFile]
+                          ) -> dict[str, list[tuple[str, int]]]:
+    """{span name -> [(path, line), ...]} over every statically-visible
+    span name: the literal FIRST argument of a bare ``span()`` /
+    ``add_span()`` call, a literal ``span_name=`` keyword argument, and
+    a ``span_name`` parameter's literal default (the engine's
+    decode/verify dispatch passes its span name through that
+    parameter). Variable names are skipped — a heuristic documented on
+    the rule; the drift guard keeps the inventory honest from the
+    other side."""
+    out: dict[str, list[tuple[str, int]]] = {}
+
+    def add(name: str, sf: SourceFile, line: int) -> None:
+        out.setdefault(name, []).append((sf.path, line))
+
+    for sf in files:
+        for n in ast.walk(sf.tree):
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Name) \
+                        and n.func.id in _SPAN_FNS and n.args \
+                        and isinstance(n.args[0], ast.Constant) \
+                        and isinstance(n.args[0].value, str):
+                    add(n.args[0].value, sf, n.args[0].lineno)
+                # the router's span wrapper: _rspan(ctx, rid, NAME,
+                # t0, t1, ...) — a span-recording entry point too
+                if _last(dotted(n.func)) == "_rspan" \
+                        and len(n.args) >= 3 \
+                        and isinstance(n.args[2], ast.Constant) \
+                        and isinstance(n.args[2].value, str):
+                    add(n.args[2].value, sf, n.args[2].lineno)
+                for kw in n.keywords:
+                    if kw.arg == "span_name" and isinstance(
+                            kw.value, ast.Constant) and isinstance(
+                            kw.value.value, str):
+                        add(kw.value.value, sf, kw.value.lineno)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = n.args
+                params = a.posonlyargs + a.args + a.kwonlyargs
+                defaults = ([None] * (len(a.posonlyargs + a.args)
+                                      - len(a.defaults))
+                            + list(a.defaults) + list(a.kw_defaults))
+                for p, d in zip(params, defaults):
+                    if p.arg == "span_name" and isinstance(
+                            d, ast.Constant) and isinstance(
+                            d.value, str):
+                        add(d.value, sf, d.lineno)
+    return out
+
+
+class Trc01(Rule):
+    name = "TRC01"
+    doc = ("span-name literals must resolve against the "
+           "docs/span_names.txt inventory")
+
+    def run(self, files):
+        try:
+            inventory = load_span_inventory()
+        except OSError as e:
+            return [Finding(
+                rule=self.name, path="docs/span_names.txt", line=0,
+                symbol="",
+                message=f"span-name inventory unreadable ({e}) — the "
+                        "rule cannot resolve any span() name")]
+        out: list[Finding] = []
+        for name, sites in sorted(collect_span_literals(files).items()):
+            if name in inventory:
+                continue
+            for path, line in sites:
+                out.append(Finding(
+                    rule=self.name, path=path, line=line, symbol="",
+                    message=(f"span name {name!r} is not in "
+                             "docs/span_names.txt — the stitcher and "
+                             "trace summaries group lanes by span "
+                             "name, so a typo'd name silently drops "
+                             "the span from every grouped view; add "
+                             "it to the inventory (and the drift "
+                             "guard) or fix the typo")))
+        return out
+
+
+# ---------------------------------------------------------------------------
 # CFG01 — config fields / CLI flags declared but never read
 # ---------------------------------------------------------------------------
 
@@ -707,7 +816,7 @@ class Cfg01(Rule):
 # ---------------------------------------------------------------------------
 
 ALL_RULES: tuple[Rule, ...] = (Jit01(), Don01(), Thr01(), Obs01(),
-                               Cfg01())
+                               Trc01(), Cfg01())
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}
 
 
