@@ -770,6 +770,132 @@ class GPT:
         vq, vs = scatter_q(v_pool, v_scale, kv["v"])
         return logits, kq, vq, ks, vs
 
+    def paged_prefill_chunk(self, params, input_ids, chunk_mask, start,
+                            k_pool, v_pool, table_row, chunk_blocks, *,
+                            k_scale=None, v_scale=None):
+        """ONE ``chunk_tokens``-wide slice of a left-aligned paged
+        prefill — the SLO scheduler's bounded-stall admission program
+        (round 18). A long prompt's monolithic prefill stalls every
+        live decode slot for the whole forward; this program processes
+        only the tokens at logical slots ``start .. start+C-1``,
+        reading the PRIOR chunks' K/V back from the pool through
+        ``table_row``, so the engine can interleave shared decode steps
+        between chunks and bound the worst-case decode stall at one
+        chunk's dispatch time.
+
+        ``input_ids``/``chunk_mask``: [1, C] (mask 1 = real token,
+        left-aligned — only the final chunk of a prompt is ragged);
+        ``start``: scalar int32, the chunk's first logical slot (the
+        engine keeps it block-aligned); ``table_row``: [NB_p] int32,
+        the slot's WHOLE prompt-capacity block run (the attention
+        gather's context window); ``chunk_blocks``: [C / Bs] int32,
+        the physical blocks this chunk writes (entries past the
+        prompt's allocated run point at the reserved null block 0,
+        whose bytes are never read). Returns ``(logits [1, V] of the
+        chunk's last real token, k_pool', v_pool')`` — the logits only
+        matter on the FINAL chunk, where they are the request's first
+        sample point, exactly like :meth:`paged_prefill`'s return.
+
+        Parity contract: per-token math is row-independent (embedding,
+        layernorm, dense) and the attention softmax over the gathered
+        pool window differs from the monolithic prefill's only by
+        exactly-zero masked terms, so with a float pool (storage dtype
+        == compute dtype) the chunked byte stream — K/V block bytes
+        AND the final-chunk logits — is bit-identical to one
+        :meth:`paged_prefill` dispatch (tier-1 tested). An int8 pool
+        re-reads prior chunks through the quantize/dequant pair the
+        monolithic prefill never pays, so int8 composition rides the
+        repo's token-agreement drift gate instead (DESIGN.md §15).
+
+        ``k_scale``/``v_scale`` switch on quantize-on-write exactly as
+        in :meth:`paged_prefill`; the return grows the same way."""
+        c = self.cfg
+        _, cw = input_ids.shape
+        bs = k_pool.shape[2]
+        nb_c = chunk_blocks.shape[0]
+        nb_p = table_row.shape[0]
+        total = nb_p * bs
+        start = jnp.asarray(start, jnp.int32)
+        cm = (jnp.asarray(chunk_mask) != 0)
+        ids = jnp.where(cm, jnp.asarray(input_ids), 0)
+        # global positions; masked lanes clamp so the wpe gather stays
+        # in range (their rows are garbage nothing reads)
+        pos_ids = jnp.clip(start + jnp.arange(cw, dtype=jnp.int32),
+                           0, c.max_len - 1)[None]
+        h, _ = self._embed(params, ids, pos_ids, rng=None, train=False)
+        # key validity over the gathered context window: every slot
+        # before this chunk holds a real prior-chunk token (chunks tile
+        # block-aligned), slots inside the chunk follow its mask, and
+        # slots at or past the chunk end were never written
+        slots = jnp.arange(total, dtype=jnp.int32)
+        in_chunk = (slots >= start) & (slots < start + cw)
+        chunk_valid = jnp.take(
+            cm[0], jnp.clip(slots - start, 0, cw - 1))
+        kv_valid = (slots < start) | (in_chunk & chunk_valid)
+        # causal: query lane j (global slot start + j) sees slot s
+        # iff s <= start + j
+        qpos = start + jnp.arange(cw, dtype=jnp.int32)
+        mask4 = (kv_valid[None, :]
+                 & (slots[None, :] <= qpos[:, None]))[None, None]
+        quant = k_scale is not None
+
+        def write(pool, fresh):
+            # [1, C, H, D] fresh K/V -> the chunk's whole blocks (same
+            # scatter shape as paged_prefill, through chunk_blocks)
+            blocks = fresh[0].reshape(nb_c, bs, *fresh.shape[2:])
+            return pool.at[chunk_blocks].set(blocks.astype(pool.dtype))
+
+        def write_q(pool, spool, fresh):
+            q, s = quantize_kv_rows(fresh[0])          # [C,H,D] / [C]
+            return (pool.at[chunk_blocks].set(
+                        q.reshape(nb_c, bs, *q.shape[1:])),
+                    spool.at[chunk_blocks].set(s.reshape(nb_c, bs)))
+
+        new_k, new_v = [], []
+        new_ks, new_vs = [], []
+        for i in range(c.layers):
+            lp = params[f"layer_{i}"]
+            q, k, v = self._qkv(lp["attn"], nn.layernorm(lp["ln1"], h))
+            # write THIS chunk's K/V first (verify-style: the gather
+            # below must already see lanes 0..j-1's keys), then gather
+            # the whole context window back through the table
+            if quant:
+                kp, ksp = write_q(k_pool[i], k_scale[i], k)
+                vp, vsp = write_q(v_pool[i], v_scale[i], v)
+                ctx_k = (kp[table_row].astype(jnp.float32)
+                         * ksp[table_row][..., None, None])
+                ctx_v = (vp[table_row].astype(jnp.float32)
+                         * vsp[table_row][..., None, None])
+                new_ks.append(ksp)
+                new_vs.append(vsp)
+            else:
+                kp = write(k_pool[i], k)
+                vp = write(v_pool[i], v)
+                ctx_k, ctx_v = kp[table_row], vp[table_row]
+            new_k.append(kp)
+            new_v.append(vp)
+            ctx_k = ctx_k.reshape(1, total, c.heads, self.head_dim) \
+                .astype(self.dtype)
+            ctx_v = ctx_v.reshape(1, total, c.heads, self.head_dim) \
+                .astype(self.dtype)
+            ctx = multi_head_attention(q, ctx_k, ctx_v, mask=mask4,
+                                       impl="xla")
+            a = nn.dense(lp["attn"]["o"],
+                         ctx.reshape(1, cw, c.hidden), dtype=self.dtype)
+            h = h + a.astype(h.dtype)
+            f = self._ffn(lp, nn.layernorm(lp["ln2"], h))
+            h = h + f.astype(h.dtype)
+        h = nn.layernorm(params["ln_f"], h)
+        p_chunk = jnp.sum(cm.astype(jnp.int32))
+        last_h = jnp.take_along_axis(
+            h, jnp.maximum(p_chunk - 1, 0)[None, None, None],
+            axis=1)[:, 0]
+        logits = self.lm_logits(params, last_h[:, None])[:, 0]
+        out = (logits, jnp.stack(new_k), jnp.stack(new_v))
+        if quant:
+            out += (jnp.stack(new_ks), jnp.stack(new_vs))
+        return out
+
     def decode_step_batched_paged(self, params, stacked, pools,
                                   block_tables, tok, pos, pad,
                                   alive=None,

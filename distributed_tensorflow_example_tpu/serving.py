@@ -46,6 +46,12 @@ _DECODE = "decode.stablehlo"
 # dispatches it instead of decode.stablehlo on iterations where any
 # live slot carries draft tokens
 _VERIFY = "verify.stablehlo"
+# chunked-prefill program (export_generator prefill_chunk=C, paged
+# only): one C-token slice of a left-aligned prompt prefill, reading
+# prior chunks back through the block table — the SLO scheduler
+# interleaves these with shared decode steps so a long prompt can
+# never stall live decoders for a whole monolithic prefill
+_PREFILL_CHUNK = "prefill_chunk.stablehlo"
 
 
 def serving_signature(batch: dict[str, Any]) -> dict[str, Any]:
@@ -203,6 +209,7 @@ def export_generator(model, params, out_dir: str, *,
                      kv_cache_dtype: str | None = None,
                      pool_bytes: int | None = None,
                      spec_tokens: int = 0,
+                     prefill_chunk: int = 0,
                      platforms: Sequence[str] = ("cpu", "tpu")) -> str:
     """Serialize ``model.generate`` (params baked; greedy or
     temperature/top-k/top-p sampling, optional EOS early-stop) as a
@@ -304,7 +311,21 @@ def export_generator(model, params, out_dir: str, *,
     ``kv_cache_dtype="int8"`` unchanged (the verify body IS the decode
     body over row-expanded inputs). ``spec_tokens`` lands in the
     ``stepwise`` metadata so the engine and the HTTP server can
-    auto-detect spec capability."""
+    auto-detect spec capability.
+
+    ``prefill_chunk=C`` (requires ``paged=True``; C a positive
+    multiple of ``block_size``) additionally exports
+    ``prefill_chunk.stablehlo`` — the C-token chunked-prefill program
+    (``GPT.paged_prefill_chunk``) the SLO-aware scheduler dispatches
+    instead of the monolithic prefill when ``--prefill_chunk_tokens``
+    is set, interleaving prompt chunks with shared decode steps so a
+    long prompt's admission can never stall live decoders for more
+    than one chunk's dispatch. With a float pool the chunked byte
+    stream is bit-identical to the monolithic prefill (the repo's
+    standing parity discipline); the int8-pool composition rides the
+    token-agreement drift gate instead. ``prefill_chunk`` lands in
+    the ``stepwise`` metadata so the engine can validate the
+    serve-time budget against the exported chunk width."""
     from .ckpt.checkpoint import _to_host
     params = jax.tree_util.tree_map(_to_host, params)
 
@@ -339,6 +360,19 @@ def export_generator(model, params, out_dir: str, *,
                 "the block-paged pool (draft rejection rewinds per-row "
                 "pos through the block tables) — export with "
                 "paged=True, or drop the knob")
+    if prefill_chunk:
+        if not paged:
+            raise ValueError(
+                "prefill_chunk exports the chunked-prefill program "
+                "over the block-paged pool (chunks fill whole blocks "
+                "through the table) — export with paged=True, or drop "
+                "the knob")
+        if prefill_chunk < 1 or prefill_chunk % block_size:
+            raise ValueError(
+                f"prefill_chunk must be a positive multiple of "
+                f"block_size={block_size} (chunks tile the left-"
+                f"aligned layout block-granularly), got "
+                f"{prefill_chunk}")
 
     sampled = temperature > 0.0
     tpu_only_on_tpu = (tuple(platforms) == ("tpu",)
@@ -393,7 +427,7 @@ def export_generator(model, params, out_dir: str, *,
             paged=paged, block_size=block_size, num_blocks=num_blocks,
             weight_quant=weight_quant, cache_dtype=cache_dtype,
             kv_quant=kv_quant, pool_bytes=pool_bytes,
-            spec_tokens=spec_tokens)
+            spec_tokens=spec_tokens, prefill_chunk=prefill_chunk)
     return _write_artifact(out_dir, exported, features, params, model,
                            kind="generator", batch_polymorphic=False,
                            prompt_len=prompt_len,
@@ -412,18 +446,22 @@ def _trace_and_write_stepwise(out_dir: str, prefill_fn, decode_fn,
                               platforms: Sequence[str],
                               base_meta: dict, verify_fn=None,
                               verify_specs: dict | None = None,
+                              chunk_fn=None,
+                              chunk_specs: dict | None = None,
                               **extra_meta) -> dict:
     """The shared tail of both stepwise exporters (slab and paged):
     trace + serialize the prefill/decode pair (plus the optional
-    speculative-verify program) to the canonical filenames (chief-only
-    write) and assemble the ``stepwise`` metadata block. ONE copy, so
-    an export-flow change (donation hints, platform knobs, a new
-    metadata key the engine reads) cannot silently diverge the two
-    artifact kinds."""
+    speculative-verify and chunked-prefill programs) to the canonical
+    filenames (chief-only write) and assemble the ``stepwise``
+    metadata block. ONE copy, so an export-flow change (donation
+    hints, platform knobs, a new metadata key the engine reads)
+    cannot silently diverge the two artifact kinds."""
     programs = [(_PREFILL, prefill_fn, prefill_specs),
                 (_DECODE, decode_fn, decode_specs)]
     if verify_fn is not None:
         programs.append((_VERIFY, verify_fn, verify_specs))
+    if chunk_fn is not None:
+        programs.append((_PREFILL_CHUNK, chunk_fn, chunk_specs))
     exported = [(name, jax_export.export(
         jax.jit(fn), platforms=list(platforms))(specs))
         for name, fn, specs in programs]
@@ -444,7 +482,8 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
                      weight_quant: str | None = None,
                      cache_dtype=None, kv_quant: str | None = None,
                      pool_bytes: int | None = None,
-                     spec_tokens: int = 0) -> dict:
+                     spec_tokens: int = 0,
+                     prefill_chunk: int = 0) -> dict:
     """Trace + serialize the prefill and shared-decode-step programs
     (see :func:`export_generator` ``stepwise=True``); returns the
     ``stepwise`` metadata block. Params are already host-gathered."""
@@ -479,7 +518,8 @@ def _export_stepwise(model, params, out_dir: str, *, prompt_len: int,
             block_size=block_size, num_blocks=num_blocks,
             cache_dtype=cache_dtype, base_meta=base_meta,
             weight_quant=weight_quant, kv_quant=kv_quant,
-            pool_bytes=pool_bytes, spec_tokens=spec_tokens)
+            pool_bytes=pool_bytes, spec_tokens=spec_tokens,
+            prefill_chunk=prefill_chunk)
     head_dim = c.hidden // c.heads
     pool_shape = (c.layers, slots, total, c.heads, head_dim)
 
@@ -533,7 +573,8 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
                            base_meta, weight_quant: str | None = None,
                            kv_quant: str | None = None,
                            pool_bytes: int | None = None,
-                           spec_tokens: int = 0) -> dict:
+                           spec_tokens: int = 0,
+                           prefill_chunk: int = 0) -> dict:
     """The block-paged stepwise pair (``export_generator``
     ``paged=True``): prefill writes a prompt's whole blocks through a
     table row, the shared decode step reads/writes through per-slot
@@ -658,6 +699,39 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
             **{k: v for k, v in decode_specs.items() if k != "tok"},
             "tok": jax.ShapeDtypeStruct((slots, spec_tokens), np.int32),
             "n_tok": jax.ShapeDtypeStruct((slots,), np.int32)}
+    chunk_fn = chunk_specs = None
+    if prefill_chunk:
+        # clamp the exported chunk width at the prompt capacity rounded
+        # to whole blocks — a wider chunk than the prompt can ever fill
+        # would only trace dead lanes
+        prefill_chunk = min(prefill_chunk, prompt_blocks * block_size)
+
+        def chunk_fn(feats):
+            scales = ({"k_scale": feats["cache_k_scale"],
+                       "v_scale": feats["cache_v_scale"]}
+                      if kv_quant else {})
+            out = model.paged_prefill_chunk(
+                params, feats["input_ids"], feats["chunk_mask"],
+                feats["start"], feats["cache_k"], feats["cache_v"],
+                feats["table_row"], feats["chunk_blocks"], **scales)
+            res = {"logits": out[0], "cache_k": out[1],
+                   "cache_v": out[2]}
+            if kv_quant:
+                res.update({"cache_k_scale": out[3],
+                            "cache_v_scale": out[4]})
+            return res
+
+        chunk_specs = {
+            "input_ids": jax.ShapeDtypeStruct((1, prefill_chunk),
+                                              np.int32),
+            "chunk_mask": jax.ShapeDtypeStruct((1, prefill_chunk),
+                                               np.int32),
+            "start": jax.ShapeDtypeStruct((), np.int32),
+            "table_row": jax.ShapeDtypeStruct((prompt_blocks,),
+                                              np.int32),
+            "chunk_blocks": jax.ShapeDtypeStruct(
+                (prefill_chunk // block_size,), np.int32),
+            **pool_specs}
     quant_meta = {}
     if kv_quant:
         quant_meta = {"kv_scale_shape": list(scale_shape),
@@ -666,10 +740,12 @@ def _export_stepwise_paged(model, params, out_dir: str, *,
         out_dir, prefill_fn, decode_fn, prefill_specs, decode_specs,
         platforms, base_meta(pool_shape),
         verify_fn=verify_fn, verify_specs=verify_specs,
+        chunk_fn=chunk_fn, chunk_specs=chunk_specs,
         paged=True, block_size=block_size, num_blocks=num_blocks,
         blocks_per_slot=blocks_per_slot, prompt_blocks=prompt_blocks,
         layout="left_aligned", block_bytes=block_bytes,
-        spec_tokens=spec_tokens, **quant_meta)
+        spec_tokens=spec_tokens, prefill_chunk=prefill_chunk,
+        **quant_meta)
 
 
 def validate_quant_meta(meta: dict, *, where: str = "artifact") -> None:
@@ -801,6 +877,18 @@ class StepwiseGenerator:
                 f"{self.spec_tokens} but {_VERIFY} is missing — the "
                 "export is torn; re-export with export_generator(..., "
                 f"spec_tokens={self.spec_tokens})")
+        #: C of the exported chunked-prefill program (0 = none — the
+        #: engine must run with chunking off)
+        self.prefill_chunk_tokens: int = int(
+            step_meta.get("prefill_chunk", 0))
+        chunk_path = os.path.join(directory, _PREFILL_CHUNK)
+        if self.prefill_chunk_tokens and not os.path.exists(chunk_path):
+            raise ValueError(
+                f"{directory!r} metadata claims prefill_chunk="
+                f"{self.prefill_chunk_tokens} but {_PREFILL_CHUNK} is "
+                "missing — the export is torn; re-export with "
+                "export_generator(..., prefill_chunk="
+                f"{self.prefill_chunk_tokens})")
         with open(os.path.join(directory, _PREFILL), "rb") as f:
             self._prefill_exp = jax_export.deserialize(f.read())
         with open(os.path.join(directory, _DECODE), "rb") as f:
@@ -809,6 +897,10 @@ class StepwiseGenerator:
         if self.spec_tokens:
             with open(verify_path, "rb") as f:
                 self._verify_exp = jax_export.deserialize(f.read())
+        self._chunk_exp = None
+        if self.prefill_chunk_tokens:
+            with open(chunk_path, "rb") as f:
+                self._chunk_exp = jax_export.deserialize(f.read())
         # donate ONLY the pool (the multi-megabyte operand): donating
         # the whole feature dict would warn per-call about the small
         # int arrays XLA can't alias into the outputs
@@ -824,6 +916,9 @@ class StepwiseGenerator:
         self._verify = (jax.jit(split(self._verify_exp.call),
                                 donate_argnums=(0,))
                         if self._verify_exp is not None else None)
+        self._chunk = (jax.jit(split(self._chunk_exp.call),
+                               donate_argnums=(0,))
+                       if self._chunk_exp is not None else None)
 
     def make_pool(self) -> dict:
         """A zeroed cache pool of the exported shape (the engine's
@@ -870,6 +965,20 @@ class StepwiseGenerator:
                 "..., spec_tokens=K) to enable speculative decoding")
         pool, rest = self._split(feats)
         return self._verify(pool, rest)
+
+    def prefill_chunk(self, feats: dict) -> dict:
+        """One C-token chunked-prefill dispatch (``input_ids``/
+        ``chunk_mask`` [1, C] + ``start``/``table_row``/
+        ``chunk_blocks``) — only on artifacts exported with
+        ``prefill_chunk=C``."""
+        if self._chunk is None:
+            raise ValueError(
+                "this artifact was exported without a chunked-prefill "
+                "program (prefill_chunk=0) — re-export with "
+                "export_generator(..., prefill_chunk=C) to enable "
+                "chunked prefill")
+        pool, rest = self._split(feats)
+        return self._chunk(pool, rest)
 
 
 def load_stepwise(directory: str) -> StepwiseGenerator:

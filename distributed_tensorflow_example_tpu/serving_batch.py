@@ -123,6 +123,61 @@ ROW-STEPS to dispatches through a measured tokens-per-dispatch EMA, so
 429 Retry-After stops overestimating by ~1/accept_rate once
 speculation lands.
 
+Round 18 — SLO-aware overload resilience (chunked prefill, priority
+admission, graceful shedding):
+
+- **Chunked prefill** — with ``prefill_chunk_tokens=C`` over an
+  artifact exported with a chunked-prefill program
+  (``export_generator(..., prefill_chunk=C)``, paged only), a COLD
+  admission no longer dispatches one monolithic prefill that stalls
+  every live decode slot for the whole prompt forward: the prompt's
+  blocks are allocated up front, the slot parks in a ``prefilling``
+  set, and the scheduler dispatches ONE block-aligned chunk
+  (``GPT.paged_prefill_chunk`` — prior chunks read back through the
+  table) per iteration, interleaved with the shared decode step, so
+  the worst-case decode stall is one chunk's dispatch instead of one
+  prompt's. The final chunk's logits are the request's first sample
+  point; greedy bytes stay byte-identical to unchunked prefill on a
+  float pool (the standing parity discipline), and
+  ``prefill_chunk_tokens=0`` (default) is a bitwise no-op — identical
+  dispatches, identical pool bytes. Prefix-cache hits/COW/int8/
+  speculation compose unchanged (hits never chunk: they mount blocks
+  and teacher-force, which already interleaves).
+- **Priority + deadline-aware admission** — per-request ``priority``
+  (``interactive`` | ``batch`` | ``best_effort``; payload knob +
+  ``default_priority``) turns the FIFO deque into an ORDERED queue:
+  :func:`select_index` picks by class, earliest-feasible-deadline
+  first within class, FIFO on ties, with AGING (one class promotion
+  per ``priority_aging_ms`` waited) so ``best_effort`` can never
+  starve behind a sustained interactive stream. A queued request
+  whose deadline is already infeasible against the MEASURED service
+  rate (:class:`RetryAfterEstimator`, decode-step + prefill-chunk
+  EMAs kept separately so chunk work cannot pollute the decode
+  estimate) is shed IMMEDIATELY with :class:`ShedError` (HTTP 429 +
+  honest Retry-After) instead of expiring into a 504 after wasting
+  queue time.
+- **Graceful degradation (brownout)** — a pressure signal (queue
+  depth + queue age + block-starvation deferrals; raw pool occupancy
+  is deliberately not a signal — a healthy prefix cache keeps the
+  pool full of reclaimable blocks) drives the explicit shedding
+  ladder ``healthy -> shed_best_effort -> shed_batch ->
+  interactive_only`` (:func:`compute_pressure_level`, hysteresis so
+  the state cannot flap): each level refuses the named classes at
+  admission with 429 + measured Retry-After, and ``interactive_only``
+  additionally sheds already-QUEUED non-interactive requests. The
+  state is published in ``/healthz`` (``pressure`` + ``saturated`` +
+  queue-age saturation fields — the router demotes a saturated
+  replica to ``degraded`` BEFORE it mass-sheds) and ``/stats``; the
+  flight recorder captures a bundle on every transition.
+  Observables: ``serving_shed_total`` (+ per-class counters),
+  ``serving_shed_infeasible_total``,
+  ``serving_pressure_transitions_total``, the
+  ``serving_pressure_level`` / ``serving_queue_age_seconds`` gauges,
+  ``serving_prefill_chunks_total``, and the
+  ``serving_decode_stall_seconds`` histogram (dispatch-to-dispatch
+  gap seen by slots that stayed live across it — the p95
+  decode-stall-under-long-prompt proof surface).
+
 Round 10 — block-paged pool + shared-prefix reuse: with a PAGED
 stepwise artifact (``export_generator(..., paged=True)``) the engine
 swaps the ``slots × T`` slab reservation for a shared pool of
@@ -175,6 +230,18 @@ class QueueFullError(Exception):
     def __init__(self, msg: str, retry_after: float = 1.0):
         super().__init__(msg)
         self.retry_after = retry_after
+
+
+class ShedError(QueueFullError):
+    """This request was SHED by the overload-resilience machinery —
+    brownout class shedding (the pressure ladder refuses its priority
+    class) or feasibility shedding (its ``deadline_ms`` is already
+    unmeetable at the measured service rate). A
+    :class:`QueueFullError` subclass so every existing 429 +
+    ``Retry-After`` mapping (HTTP layer, router pushback) applies
+    unchanged; the Retry-After is the measured estimate, never a
+    guess, and shedding NOW beats expiring into a 504 after wasting
+    queue time."""
 
 
 class DrainingError(Exception):
@@ -619,6 +686,72 @@ class NgramDrafter:
         return []
 
 
+#: request priority classes, best first — admission order, the shed
+#: ladder, and the payload/--default_priority validation all key on
+#: this tuple
+PRIORITIES = ("interactive", "batch", "best_effort")
+_PRIO_RANK = {p: i for i, p in enumerate(PRIORITIES)}
+
+#: the brownout ladder: each level sheds the classes ranked at or
+#: below it (level 1 sheds best_effort, 2 sheds batch too, 3 is
+#: interactive-only and also evicts queued non-interactive requests)
+PRESSURE_STATES = ("healthy", "shed_best_effort", "shed_batch",
+                   "interactive_only")
+
+#: saturation-score thresholds to ENTER each pressure level (index 1
+#: onward), and the hysteresis subtracted to EXIT — a score oscillating
+#: on a boundary cannot flap the state (and with it the router's view
+#: of this replica) every scheduler iteration
+PRESSURE_ENTER = (0.50, 0.75, 0.90)
+PRESSURE_HYSTERESIS = 0.10
+
+
+def compute_pressure_level(prev_level: int, score: float) -> int:
+    """The shedding ladder's transition rule: the new level for a
+    saturation ``score`` in [0, 1+] given the current level, with
+    hysteresis — a level is entered at ``PRESSURE_ENTER[level-1]`` and
+    exited only below that bound minus ``PRESSURE_HYSTERESIS``. Pure
+    (unit-testable without an engine); the engine feeds it
+    max(queue-depth fraction, queue-age fraction, block-starvation
+    deferral EMA) once per scheduler iteration."""
+    level = 0
+    for i, bound in enumerate(PRESSURE_ENTER):
+        enter = bound
+        if prev_level > i:          # already at/above: exit bound
+            enter = bound - PRESSURE_HYSTERESIS
+        if score >= enter:
+            level = i + 1
+    return level
+
+
+def select_index(queue, now: float, *, aging_s: float) -> int:
+    """Index of the next request to admit from ``queue`` (a sequence
+    of :class:`GenRequest`): best priority class first, earliest
+    deadline first within a class (no deadline sorts last), queue
+    order (FIFO) on ties. AGING promotes a waiting request one class
+    per ``aging_s`` waited — UNBOUNDED below zero, so not only can a
+    ``best_effort`` request never starve behind a sustained
+    ``interactive`` stream, a deadline-LESS request can never starve
+    behind a sustained stream of deadline-carrying siblings of its
+    own class either (EDF only orders within an effective rank; an
+    aged request eventually outranks every newcomer outright).
+    ``aging_s <= 0`` disables aging. Pure — the no-starvation test
+    drives it with an injected clock, no engine and no sleeps. With
+    every request at the default class and no deadlines the winner is
+    index 0: plain FIFO (the oldest request is both first in queue
+    order and most aged), so the priority machinery is a bitwise
+    no-op for priority-less traffic."""
+    best, best_key = 0, None
+    for i, r in enumerate(queue):
+        rank = _PRIO_RANK.get(r.priority, 0)
+        if aging_s > 0:
+            rank -= int((now - r.submitted_at) / aging_s)
+        key = (rank, r.deadline_t if r.deadline_t else float("inf"), i)
+        if best_key is None or key < best_key:
+            best, best_key = i, key
+    return best
+
+
 class RetryAfterEstimator:
     """Retry-After from MEASURED service rate: an EMA over decode-step
     wall times × the estimated steps until a slot frees (scaled by how
@@ -633,7 +766,19 @@ class RetryAfterEstimator:
     at the spec-off truth of exactly 1.0, fed the mean per-row advance
     of every dispatch) and :meth:`dispatches_for` converts row-steps
     to dispatches through it — with speculation off the divisor stays
-    exactly 1.0, so the pre-spec arithmetic is bitwise unchanged."""
+    exactly 1.0, so the pre-spec arithmetic is bitwise unchanged.
+
+    Chunked prefill (round 18) shares the scheduler iteration with
+    decode dispatches, and a chunk's wall time is a PROMPT-side cost a
+    decode-step estimate must never absorb: one long-prompt admission
+    would otherwise inflate the decode EMA and every queue-full
+    Retry-After with it. The EMA is therefore SPLIT — decode
+    dispatches feed :meth:`observe` (``ema_step_s``, exactly as
+    before), chunk dispatches feed :meth:`observe_prefill`
+    (``ema_prefill_chunk_s``) — and :meth:`time_for` prices a
+    request's remaining work from both components (the feasibility
+    shed's input), while :meth:`estimate` keeps reading the pure
+    decode EMA."""
 
     def __init__(self, alpha: float = 0.2):
         if not 0.0 < alpha <= 1.0:
@@ -643,12 +788,44 @@ class RetryAfterEstimator:
         #: mean tokens one dispatch advances a live row by — exactly
         #: 1.0 until a verify dispatch accepts a draft
         self.ema_tokens_per_dispatch: float = 1.0
+        #: EMA over chunked-prefill dispatch wall times — None until a
+        #: chunk dispatched; NEVER folded into ema_step_s (the split
+        #: that keeps Retry-After a decode measurement under chunked
+        #: prefill)
+        self.ema_prefill_chunk_s: float | None = None
 
     def observe(self, step_s: float) -> None:
         if self.ema_step_s is None:
             self.ema_step_s = float(step_s)
         else:
             self.ema_step_s += self.alpha * (step_s - self.ema_step_s)
+
+    def observe_prefill(self, chunk_s: float) -> None:
+        """Feed one chunked-prefill dispatch's wall time — the
+        prefill-side EMA, kept apart from the decode-step EMA by
+        construction."""
+        if self.ema_prefill_chunk_s is None:
+            self.ema_prefill_chunk_s = float(chunk_s)
+        else:
+            self.ema_prefill_chunk_s += self.alpha * (
+                float(chunk_s) - self.ema_prefill_chunk_s)
+
+    def time_for(self, row_steps: float, *,
+                 prefill_chunks: int = 0) -> float | None:
+        """Expected seconds to run ``row_steps`` decode row-steps plus
+        ``prefill_chunks`` chunk dispatches, each priced by its OWN
+        EMA (a chunk falls back to the decode EMA only before any
+        chunk was measured). None before any decode signal exists —
+        the feasibility shed must never act on a fake estimate."""
+        if self.ema_step_s is None:
+            return None
+        t = self.ema_step_s * self.dispatches_for(row_steps)
+        if prefill_chunks:
+            per = (self.ema_prefill_chunk_s
+                   if self.ema_prefill_chunk_s is not None
+                   else self.ema_step_s)
+            t += per * prefill_chunks
+        return t
 
     def observe_advance(self, mean_tokens: float) -> None:
         """Feed one dispatch's mean per-row advance (1.0 for a normal
@@ -739,6 +916,9 @@ class GenRequest:
     # perf_counter instant the scheduler enforces between steps
     deadline_ms: int = 0
     deadline_t: float = 0.0
+    # admission class (PRIORITIES): orders the queue (select_index)
+    # and names the brownout ladder rung that sheds this request
+    priority: str = "interactive"
     # host-side stop sequences: generation retires the moment the
     # emitted tokens end with any of these, the match itself truncated
     # from the output (checked after EVERY accepted token, so the
@@ -857,6 +1037,11 @@ class _Slot:
         #: accepted draft tokens over the request's lifetime (the
         #: `spec_accepted` timings field)
         self.spec_accepted = 0
+        # ---- chunked prefill (round 18) -----------------------------
+        #: prompt tokens already written by chunk dispatches; only
+        #: meaningful while the slot sits in the engine's _prefilling
+        #: set (a slot joins _live with the prompt fully resident)
+        self.chunk_done = 0
 
     def remaining_steps(self) -> int:
         """ROW-STEPS until this slot retires at its max_new bound (EOS
@@ -869,7 +1054,7 @@ class _Slot:
 
 @scheduler_owned("_pool", "_live", "_free", "_admitting", "_tables",
                  "blocks", "prefix_cache", "_slot_freed_t", "_retry",
-                 "_steps_to_free_hint", "_admit_counter")
+                 "_steps_to_free_hint", "_admit_counter", "_prefilling")
 class GenerationEngine:
     """The continuous-batching scheduler (see module docstring).
 
@@ -892,6 +1077,11 @@ class GenerationEngine:
                  drain_timeout_s: float = 30.0,
                  stall_after_s: float = 10.0,
                  spec_tokens: int = 0,
+                 prefill_chunk_tokens: int = 0,
+                 default_priority: str = "interactive",
+                 priority_aging_ms: int = 2000,
+                 shed_policy: str = "auto",
+                 pressure_age_budget_s: float = 5.0,
                  process: str = "serving",
                  flight_recorder=None):
         self.sw = stepwise
@@ -1023,6 +1213,57 @@ class GenerationEngine:
             "serving_queue_depth", "requests waiting for admission")
         self._g_live_slots = reg.gauge(
             "serving_live_slots", "cache-pool slots currently decoding")
+        # ---- SLO/overload observables (round 18): registered
+        # unconditionally so /stats//metrics keys are stable; zeros
+        # while chunking/shedding never trigger
+        self._c_prefill_chunks = reg.counter(
+            "serving_prefill_chunks_total",
+            "chunked-prefill dispatches (prefill_chunk_tokens > 0)")
+        self._c_shed = reg.counter(
+            "serving_shed_total",
+            "requests shed with 429 + measured Retry-After by the "
+            "brownout ladder or the feasibility rule (all classes)")
+        self._c_shed_class = {
+            "interactive": reg.counter(
+                "serving_shed_interactive_total",
+                "interactive requests shed (feasibility only — the "
+                "brownout ladder never sheds interactive)"),
+            "batch": reg.counter(
+                "serving_shed_batch_total",
+                "batch requests shed by the ladder or feasibility"),
+            "best_effort": reg.counter(
+                "serving_shed_best_effort_total",
+                "best_effort requests shed by the ladder or "
+                "feasibility"),
+        }
+        self._c_shed_infeasible = reg.counter(
+            "serving_shed_infeasible_total",
+            "queued requests shed because their deadline_ms was "
+            "already unmeetable at the measured service rate (429 "
+            "now instead of a 504 after wasted queue time)")
+        self._c_pressure_transitions = reg.counter(
+            "serving_pressure_transitions_total",
+            "brownout ladder state changes (either direction)")
+        self._g_pressure_level = reg.gauge(
+            "serving_pressure_level",
+            "current brownout rung (0 healthy .. 3 interactive_only)")
+        self._g_queue_age = reg.gauge(
+            "serving_queue_age_seconds",
+            "age of the oldest queued request (0 when the queue is "
+            "empty) — the saturation signal /healthz republishes")
+        self._g_prefilling_slots = reg.gauge(
+            "serving_prefilling_slots",
+            "slots mid-chunked-prefill (holding blocks, not yet "
+            "decoding)")
+        self._h_decode_stall = reg.histogram(
+            "serving_decode_stall_seconds",
+            "gap between consecutive shared dispatches as seen by "
+            "slots that stayed live across it — the decode-stall-"
+            "under-long-prompt proof surface chunked prefill bounds",
+            buckets=SERVING_LATENCY_BUCKETS)
+        # perf_counter stamp of the previous shared dispatch while any
+        # slot survived it (scheduler-thread-only scalar)
+        self._last_dispatch_t: float = 0.0
         # request-phase histograms register the AUDITED bucket set
         # (obs/registry.py SERVING_LATENCY_BUCKETS): sub-ms bounds for
         # the µs-scale queue/prefill phases the 1ms-floored default
@@ -1085,6 +1326,74 @@ class GenerationEngine:
         #: the exported verify program's lane width (the dispatch
         #: shape); 0 when speculation is off for this engine
         self._verify_width = art_spec if spec_tokens else 0
+        # ---- SLO-aware overload resilience (round 18) ---------------
+        if default_priority not in PRIORITIES:
+            raise ValueError(
+                f"default_priority must be one of {PRIORITIES}, got "
+                f"{default_priority!r}")
+        if priority_aging_ms < 0:
+            raise ValueError(
+                f"priority_aging_ms must be >= 0 (0 disables aging), "
+                f"got {priority_aging_ms}")
+        if shed_policy not in ("auto", "off"):
+            raise ValueError(f"shed_policy must be 'auto' or 'off', "
+                             f"got {shed_policy!r}")
+        if pressure_age_budget_s <= 0:
+            raise ValueError(f"pressure_age_budget_s must be > 0, got "
+                             f"{pressure_age_budget_s}")
+        self.default_priority = default_priority
+        self.priority_aging_s = priority_aging_ms / 1e3
+        self.shed_policy = shed_policy
+        self.pressure_age_budget_s = float(pressure_age_budget_s)
+        art_chunk = int(getattr(stepwise, "prefill_chunk_tokens", 0))
+        if prefill_chunk_tokens:
+            if not getattr(stepwise, "paged", False):
+                raise ValueError(
+                    "prefill_chunk_tokens needs a PAGED stepwise "
+                    "artifact (chunks fill whole blocks through the "
+                    "table) — re-export with paged=True")
+            if not art_chunk:
+                raise ValueError(
+                    "prefill_chunk_tokens > 0 but this artifact "
+                    "carries no chunked-prefill program — re-export "
+                    "with export_generator(..., prefill_chunk="
+                    f"{prefill_chunk_tokens}), or run with "
+                    "prefill_chunk_tokens=0")
+            bs_chunk = int(stepwise.step_meta["block_size"])
+            if prefill_chunk_tokens % bs_chunk:
+                raise ValueError(
+                    f"prefill_chunk_tokens {prefill_chunk_tokens} "
+                    f"must be a multiple of block_size {bs_chunk} "
+                    "(chunks tile the left-aligned layout block-"
+                    "granularly)")
+            if prefill_chunk_tokens > art_chunk:
+                raise ValueError(
+                    f"prefill_chunk_tokens {prefill_chunk_tokens} "
+                    f"exceeds this artifact's exported chunk width "
+                    f"{art_chunk} (prefill_chunk in export.json) — "
+                    "re-export wider, or lower the knob")
+        #: per-iteration chunked-prefill token budget (0 = off: cold
+        #: admissions dispatch the monolithic prefill, bitwise the
+        #: pre-round-18 behavior)
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        #: the exported chunk program's static width (>= the budget)
+        self._chunk_width = art_chunk if prefill_chunk_tokens else 0
+        #: slots mid-chunked-prefill (index -> _Slot); scheduler-owned
+        #: like _live — these slots hold blocks but never ride the
+        #: shared decode dispatch until their final chunk lands
+        self._prefilling: dict[int, _Slot] = {}
+        #: brownout ladder position (index into PRESSURE_STATES); a
+        #: plain int refreshed by the scheduler each iteration so
+        #: submit threads and health() read it without locking (same
+        #: convention as _steps_to_free_hint / _heartbeat)
+        self._pressure_level: int = 0
+        # block-starvation signal: raw pool occupancy is NOT pressure
+        # (a healthy prefix cache keeps the pool deliberately full, and
+        # its blocks are reclaimable) — what is pressure is admissions
+        # actually DEFERRING for lack of blocks, so the score reads an
+        # EMA over deferral-per-iteration instead
+        self._block_deferred = False
+        self._defer_ema = 0.0
         # ---- block-paged pool state (paged stepwise artifacts) ------
         self.paged: bool = bool(getattr(stepwise, "paged", False))
         self._c_tokens_saved = reg.counter(
@@ -1215,6 +1524,7 @@ class GenerationEngine:
                       deadline_ms: int | None = None,
                       stop_sequences=None,
                       spec_tokens: int | None = None,
+                      priority: str | None = None,
                       eos_id: int | None = ...) -> GenRequest:
         """Validate client inputs into a :class:`GenRequest` — every
         check happens HERE, on the caller's thread, so nothing
@@ -1260,6 +1570,13 @@ class GenerationEngine:
                 "top_k/top_p shape the SAMPLING distribution; greedy "
                 "decoding (temperature=0) would silently ignore them — "
                 "set temperature > 0")
+        if priority is None:
+            priority = self.default_priority
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got "
+                f"{priority!r}")
+        req.priority = priority
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         if isinstance(deadline_ms, bool) \
@@ -1329,6 +1646,27 @@ class GenerationEngine:
                     "engine is draining (graceful shutdown): no new "
                     "admissions — retry later or against another "
                     "replica", retry_after=self._retry_after())
+            if self.shed_policy == "auto" and self._pressure_level:
+                level = self._pressure_level
+                # rung N refuses the classes ranked >= max(1, 3-N):
+                # 1 -> best_effort, 2 -> batch too, 3 stays
+                # interactive-only (interactive is never ladder-shed)
+                floor = max(1, len(PRIORITIES) - level)
+                victims = [r for r in reqs
+                           if _PRIO_RANK[r.priority] >= floor]
+                if victims:
+                    ra = self._retry_after()
+                    with self.registry.atomic():
+                        for r in victims:
+                            self._c_shed.inc()
+                            self._c_shed_class[r.priority].inc()
+                    raise ShedError(
+                        f"shedding {victims[0].priority} requests "
+                        f"under load (pressure "
+                        f"{PRESSURE_STATES[level]}: queue "
+                        f"{len(self._queue)}/{self.max_queue}) — "
+                        "retry after the hint, or raise the "
+                        "request's priority", retry_after=ra)
             if len(self._queue) + len(reqs) > self.max_queue:
                 raise QueueFullError(
                     f"admission queue full ({len(self._queue)} waiting, "
@@ -1430,6 +1768,7 @@ class GenerationEngine:
         thread exited (clean close/drain, or a crash); ``idle`` before
         ``start()``. Reads only cross-thread-safe state — never the
         scheduler-owned fields."""
+        now = time.perf_counter()
         with self._cond:
             queued = len(self._queue)
             inflight = len(self._inflight_ids)
@@ -1443,11 +1782,19 @@ class GenerationEngine:
             status = "idle"
         else:
             status = "dead"
+        level = self._pressure_level
         return {"status": status,
                 "heartbeat_age_s": round(age, 3),
                 "stall_after_s": self.stall_after_s,
                 "queue_depth": queued, "inflight": inflight,
-                "draining": draining}
+                "draining": draining,
+                # round-18 saturation fields: a live-but-overloaded
+                # replica must be VISIBLE as such so the fleet router
+                # can demote it to degraded before it mass-sheds
+                "queue_age_s": round(self._queue_age_s(now), 3),
+                "queue_limit": self.max_queue,
+                "pressure": PRESSURE_STATES[level],
+                "saturated": level >= 2}
 
     def set_stall_after(self, stall_after_s: float,
                         settle_timeout_s: float = 2.0) -> None:
@@ -1515,6 +1862,18 @@ class GenerationEngine:
                     flush()
         return drain_ms
 
+    def _queue_age_s(self, now: float) -> float:
+        """Age of the oldest queued request (0.0 when empty) — ONE
+        definition for the saturation signal that health(), the
+        pressure tick, and the ``serving_queue_age_seconds`` gauge
+        all republish, so the three views can never drift. Thread-safe
+        (the queue is shared under ``_cond``; the Condition's RLock
+        makes nested calls from lock-holding sites safe)."""
+        with self._cond:
+            oldest = min((r.submitted_at for r in self._queue),
+                         default=None)
+        return (now - oldest) if oldest is not None else 0.0
+
     @snapshot_view
     def _retry_after(self) -> float:
         """Retry-After from the measured decode-step EMA × estimated
@@ -1576,7 +1935,8 @@ class GenerationEngine:
         err = RuntimeError("generation engine stopped")
         with self._cond:
             self._c_requests_failed.inc(len(self._queue)
-                                        + len(self._live))  # graftlint: disable=THR01
+                                        + len(self._live)  # graftlint: disable=THR01
+                                        + len(self._prefilling))  # graftlint: disable=THR01
             for req in self._queue:
                 req.future.set_exception(err)
             self._queue.clear()
@@ -1585,6 +1945,10 @@ class GenerationEngine:
                 slot.req.future.set_exception(err)
             self._live.clear()  # graftlint: disable=THR01
             self._g_live_slots.set(0)
+            for slot in self._prefilling.values():  # graftlint: disable=THR01
+                slot.req.future.set_exception(err)
+            self._prefilling.clear()  # graftlint: disable=THR01
+            self._g_prefilling_slots.set(0)
             self._inflight_ids.clear()
             self._cancel_ids.clear()
 
@@ -1596,17 +1960,29 @@ class GenerationEngine:
             self._heartbeat = time.monotonic()
             with self._cond:
                 while (self._running and not self._queue
-                       and not self._live and not self._cancel_ids):
+                       and not self._live and not self._prefilling
+                       and not self._cancel_ids):
                     self._cond.wait(timeout=self._idle_wait_s)
                     # idle bump: the watchdog must see a parked-but-
                     # healthy scheduler as live, not stalled
                     self._heartbeat = time.monotonic()
+                    # idle decay: with nothing queued the saturation
+                    # score is 0, and the ladder must walk back to
+                    # healthy HERE — an idle engine otherwise reports
+                    # its last brownout rung forever and the fleet
+                    # router would keep a recovered replica degraded
+                    # (Condition's RLock makes the nested acquire in
+                    # _update_pressure safe on this thread)
+                    if self._pressure_level:
+                        self._update_pressure()
                 if not self._running:
                     return
             try:
                 self._apply_cancellations()
                 self._expire_deadlines()
+                self._update_pressure()
                 self._admit()
+                self._prefill_chunk_step()
                 if self._live:
                     self._shared_step()
             except Exception as e:
@@ -1623,25 +1999,32 @@ class GenerationEngine:
                 err = RuntimeError(f"scheduler step failed: {e}")
                 log.warning("engine-fatal scheduler fault (%d live "
                             "request(s) failed, pool rebuilt): %s",
-                            len(self._live), e)
+                            len(self._live) + len(self._prefilling), e)
                 if self._flightrec is not None:
                     self._flightrec.incident(
                         "engine_fatal_rebuild",
                         detail=f"{type(e).__name__}: {e}",
-                        extra={"live_requests": len(self._live)})
+                        extra={"live_requests": len(self._live)
+                               + len(self._prefilling)})
                 with self._cond:
                     if self._admitting is not None:
                         self._admitting.future.set_exception(err)
                         self._admitting = None
                         self._c_requests_failed.inc()
-                    self._c_requests_failed.inc(len(self._live))
+                    self._c_requests_failed.inc(len(self._live)
+                                                + len(self._prefilling))
                     for slot in self._live.values():
                         slot.req.future.set_exception(err)
+                    for slot in self._prefilling.values():
+                        slot.req.future.set_exception(err)
                     self._live.clear()
+                    self._prefilling.clear()
                     self._g_live_slots.set(0)
+                    self._g_prefilling_slots.set(0)
                     self._free = list(range(self.slots))[::-1]
                     self._inflight_ids.clear()
                     self._cancel_ids.clear()
+                self._last_dispatch_t = 0.0
                 self._pool = self.sw.make_pool()
                 if self.paged:
                     # the rebuilt pool is empty: every table entry and
@@ -1666,7 +2049,8 @@ class GenerationEngine:
             if not self._cancel_ids:
                 return
             ids = set(self._cancel_ids)
-        for slot in list(self._live.values()):
+        for slot in (list(self._live.values())
+                     + list(self._prefilling.values())):
             rid = slot.req.request_id
             if rid in ids:
                 self._fail_slot(slot, RequestCancelledError(
@@ -1719,7 +2103,8 @@ class GenerationEngine:
             r.future.set_exception(DeadlineExceededError(
                 f"request {r.request_id} missed its {r.deadline_ms} ms "
                 "deadline while queued (never admitted)"))
-        for slot in list(self._live.values()):
+        for slot in (list(self._live.values())
+                     + list(self._prefilling.values())):
             req = slot.req
             if req.deadline_t and now >= req.deadline_t:
                 self._fail_slot(slot, DeadlineExceededError(
@@ -1746,7 +2131,15 @@ class GenerationEngine:
             with self._cond:
                 if not self._queue or not self._free:
                     return
-                req = self._queue.popleft()
+                # ordered admission (round 18): class, then earliest
+                # deadline, then FIFO — with aging so best_effort is
+                # served within a bounded wait. Priority-less traffic
+                # (every request at the default class, no deadlines)
+                # selects index 0: exactly the old popleft.
+                i = select_index(self._queue, time.perf_counter(),
+                                 aging_s=self.priority_aging_s)
+                req = self._queue[i]
+                del self._queue[i]
                 index = self._free.pop()
                 self._g_queue_depth.set(len(self._queue))
                 self._admitting = req
@@ -1919,8 +2312,12 @@ class GenerationEngine:
                 self.prefix_cache.evict(needed)
             run = self.blocks.alloc(needed)
         except BlocksExhaustedError as e:
-            if self._live:
+            if self._live or self._prefilling:
                 # retirement will free blocks — try again next boundary
+                # (the deferral is the pressure ladder's
+                # block-starvation signal: demand waiting on a pool
+                # that cannot serve it)
+                self._block_deferred = True
                 with self._cond:
                     self._queue.appendleft(req)
                     self._g_queue_depth.set(len(self._queue))
@@ -1933,6 +2330,24 @@ class GenerationEngine:
             self._fail_admission(req, index, BlocksExhaustedError(
                 f"prompt of {p} tokens needs {needed} cache blocks but "
                 f"the pool cannot free them: {e}"))
+            return True
+        if self.prefill_chunk_tokens:
+            # chunked-prefill admission: the block run is secured and
+            # the slot PARKS — no prefill dispatch here; the scheduler
+            # feeds one chunk per iteration (_prefill_chunk_step),
+            # interleaved with the shared decode step, and the final
+            # chunk's logits become the first sample point
+            self._tables[index, :needed] = run
+            with self.registry.atomic():
+                self._c_admissions.inc()
+                if self.prefix_cache is not None:
+                    self.prefix_cache.record_miss()
+            self._admit_counter += 1
+            slot = _Slot(req, index, pad=0, pos=0, rng=req.sampler(),
+                         seq=self._admit_counter)
+            slot.drafter = self._drafter_for(req)
+            self._prefilling[index] = slot
+            self._g_prefilling_slots.set(len(self._prefilling))
             return True
         table_row = np.zeros((self.prompt_blocks,), np.int32)
         table_row[:needed] = run
@@ -1981,6 +2396,206 @@ class GenerationEngine:
         return True
 
     @scheduler_thread
+    def _prefill_chunk_step(self) -> None:
+        """Dispatch ONE chunked-prefill chunk for the oldest parked
+        slot (admission order) — at most ``prefill_chunk_tokens``
+        prompt tokens per scheduler iteration, so the shared decode
+        step between chunks can never be stalled longer than one
+        chunk's dispatch. The final chunk's logits are the request's
+        first sample point: the slot leaves ``_prefilling``, its
+        prompt enters the prefix cache (the cold path's insert,
+        deferred to when the bytes are actually resident), and
+        :meth:`_emit` takes it live. A chunk failure that left the
+        donated pool intact quarantines THIS request alone (blocks
+        released, neighbors undisturbed — the prefill protocol); a
+        pool-consuming fault re-raises into the engine-fatal
+        handler."""
+        if not self._prefilling:
+            return
+        slot = min(self._prefilling.values(),
+                   key=lambda s: s.admit_seq)
+        req = slot.req
+        tokens = np.asarray(req.prompt, np.int32)
+        p = int(tokens.size)
+        start = slot.chunk_done
+        n = min(self.prefill_chunk_tokens, p - start)
+        cw = self._chunk_width
+        bs = self.block_size
+        ids = np.zeros((1, cw), np.int32)
+        mask = np.zeros((1, cw), np.int32)
+        ids[0, :n] = tokens[start:start + n]
+        mask[0, :n] = 1
+        # write targets: the chunk's whole blocks out of this slot's
+        # table row; lanes past the prompt's allocated run write the
+        # reserved null block (never read — the paged convention)
+        needed = -(-p // bs)
+        row = self._tables[slot.index]
+        cb = np.zeros((cw // bs,), np.int32)
+        for j in range(cw // bs):
+            bi = start // bs + j
+            if bi < needed:
+                cb[j] = row[bi]
+        t0 = time.perf_counter()
+        try:
+            with span("prefill_chunk", process=self.process,
+                      lane=f"slot{slot.index}",
+                      request_id=req.request_id, start=start,
+                      chunk_tokens=n, prompt_tokens=p, **req.trace):
+                faults.inject("engine.prefill",
+                              detail=f"{req.request_id}@{start}")
+                out = self.sw.prefill_chunk({
+                    "input_ids": ids, "chunk_mask": mask,
+                    "start": np.int32(start),
+                    "table_row": np.ascontiguousarray(
+                        row[:self.prompt_blocks]),
+                    "chunk_blocks": cb, **self._pool})
+                # materialize BEFORE adopting the returned pool (the
+                # _admit_slab convention): an async device fault must
+                # leave self._pool naming the donated inputs so
+                # _pool_alive() escalates correctly
+                logits0 = np.asarray(out["logits"])[0]
+                self._pool = {k: v for k, v in out.items()
+                              if k.startswith("cache_")}
+        except Exception as e:
+            if not self._pool_alive():
+                raise          # donated pool consumed: engine-fatal
+            log.warning("chunked prefill of request %s failed at "
+                        "token %d (quarantined): %s", req.request_id,
+                        start, e)
+            self._fail_slot(slot, PoisonedRequestError(
+                f"request {req.request_id} failed at prefill chunk "
+                f"starting token {start} ({type(e).__name__}: {e}); "
+                "its neighbors were not disturbed"))
+            return
+        # the SPLIT estimator: chunk wall time feeds the prefill EMA,
+        # never the decode-step EMA Retry-After reads
+        self._retry.observe_prefill(time.perf_counter() - t0)
+        self._c_prefill_chunks.inc()
+        slot.chunk_done = start + n
+        if slot.chunk_done < p:
+            return
+        # prompt fully resident: same tail as the monolithic cold path
+        slot.pos = p
+        slot.t_prefill_done = time.perf_counter()
+        del self._prefilling[slot.index]
+        self._g_prefilling_slots.set(len(self._prefilling))
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(
+                tokens, [int(b) for b in row[:needed]])
+        tok = self._pick(slot, logits0)
+        self._emit(slot, tok)
+        with self._cond:
+            self._g_live_slots.set(len(self._live))
+
+    @scheduler_thread
+    def _update_pressure(self) -> None:
+        """One brownout-ladder tick: refresh the queue-age gauge, shed
+        queued requests whose deadline is already infeasible at the
+        measured service rate (429 now beats a 504 after wasted queue
+        time), recompute the pressure level from the saturation score
+        (queue depth + queue age + the block-starvation deferral EMA,
+        with hysteresis), and — at ``interactive_only`` — shed the queued
+        non-interactive backlog. ``shed_policy="off"`` keeps only the
+        gauge refresh: no ladder, no feasibility shed."""
+        now = time.perf_counter()
+        with self._cond:
+            depth = len(self._queue)
+        age = self._queue_age_s(now)
+        self._g_queue_age.set(round(age, 4))
+        if self.shed_policy != "auto":
+            return
+        self._shed_infeasible(now)
+        self._defer_ema += 0.2 * (
+            (1.0 if self._block_deferred else 0.0) - self._defer_ema)
+        self._block_deferred = False
+        score = max(depth / max(1, self.max_queue),
+                    age / self.pressure_age_budget_s,
+                    self._defer_ema)
+        level = compute_pressure_level(self._pressure_level, score)
+        if level != self._pressure_level:
+            log.warning("pressure %s -> %s (score %.2f: queue %d/%d, "
+                        "age %.2fs)", PRESSURE_STATES[
+                            self._pressure_level],
+                        PRESSURE_STATES[level], score, depth,
+                        self.max_queue, age)
+            self._c_pressure_transitions.inc()
+            if self._flightrec is not None:
+                self._flightrec.incident(
+                    "pressure_transition",
+                    detail=f"{PRESSURE_STATES[self._pressure_level]} "
+                           f"-> {PRESSURE_STATES[level]}",
+                    extra={"score": round(score, 3),
+                           "queue_depth": depth,
+                           "queue_age_s": round(age, 3)})
+            self._pressure_level = level
+        self._g_pressure_level.set(level)
+        if level >= 3:
+            # interactive_only: the queued non-interactive backlog is
+            # shed too — it would only age into deadline expiry while
+            # starving the interactive class the rung protects
+            self._shed_queued(
+                lambda r: r.priority != "interactive",
+                reason="pressure interactive_only")
+
+    @scheduler_thread
+    def _shed_infeasible(self, now: float) -> None:
+        """Shed queued requests whose ``deadline_ms`` can no longer be
+        met at the MEASURED service rate (decode-step + prefill-chunk
+        EMAs, each work class priced by its own component). Never acts
+        before the estimator has a real decode signal — no signal
+        beats a fake one.
+
+        Pricing is the WORST CASE (``max_new`` row-steps; the engine
+        cannot know whether a generation will EOS early) — the only
+        estimate that is sound against the deadline promise: a request
+        priced optimistically would be admitted, hold a slot, and
+        still 504 whenever EOS doesn't come. Deadline-carrying clients
+        that rely on early stopping should send a realistic
+        ``max_new`` cap with the deadline."""
+        if not self._retry.seeded:
+            return
+        budget = self.prefill_chunk_tokens
+
+        def infeasible(r: GenRequest) -> bool:
+            if not r.deadline_t:
+                return False
+            chunks = (-(-int(r.prompt.size) // budget) if budget
+                      else 0)
+            need = self._retry.time_for(r.max_new,
+                                        prefill_chunks=chunks)
+            return need is not None and now + need > r.deadline_t
+
+        self._shed_queued(infeasible, reason="deadline infeasible",
+                          infeasible_counter=True)
+
+    @scheduler_thread
+    def _shed_queued(self, pred, *, reason: str,
+                     infeasible_counter: bool = False) -> None:
+        """Remove queued requests matching ``pred`` and fail them with
+        :class:`ShedError` (429 + measured Retry-After) — shedding
+        BEFORE a slot or more queue time is wasted on them."""
+        with self._cond:
+            victims = [r for r in self._queue if pred(r)]
+            for r in victims:
+                self._queue.remove(r)
+            if victims:
+                self._g_queue_depth.set(len(self._queue))
+        if not victims:
+            return
+        ra = self._retry_after()
+        with self.registry.atomic():
+            for r in victims:
+                self._c_shed.inc()
+                self._c_shed_class[r.priority].inc()
+                if infeasible_counter:
+                    self._c_shed_infeasible.inc()
+        for r in victims:
+            r.future.set_exception(ShedError(
+                f"request {r.request_id} shed while queued "
+                f"({reason}) — retry after the hint",
+                retry_after=ra))
+
+    @scheduler_thread
     def _release_slot_blocks(self, index: int) -> None:
         """Retirement/failure: drop this slot's table references (a
         block shared with the prefix cache or another slot survives —
@@ -1995,14 +2610,25 @@ class GenerationEngine:
     @scheduler_thread
     def _fail_slot(self, slot: _Slot, err: Exception,
                    counter=None) -> None:
-        """Retire ONE live request with ``err`` — block exhaustion,
-        quarantine eviction, cancellation, or deadline expiry — without
-        disturbing its neighbors: table refs released (paged), slot
-        freed, THEN the future resolves. ``counter`` picks which
-        retirement counter advances (default: requests_failed)."""
+        """Retire ONE live (or mid-chunked-prefill) request with
+        ``err`` — block exhaustion, quarantine eviction, cancellation,
+        or deadline expiry — without disturbing its neighbors: table
+        refs released (paged), slot freed, THEN the future resolves.
+        ``counter`` picks which retirement counter advances (default:
+        requests_failed)."""
         if self.paged:
             self._release_slot_blocks(slot.index)
-        del self._live[slot.index]
+        if slot.index in self._prefilling \
+                and self._prefilling[slot.index] is slot:
+            del self._prefilling[slot.index]
+            self._g_prefilling_slots.set(len(self._prefilling))
+        else:
+            del self._live[slot.index]
+            if not self._live:
+                # nobody decodes across the coming gap: the stall
+                # stamp must not survive into the next dispatch as a
+                # spurious giant serving_decode_stall_seconds sample
+                self._last_dispatch_t = 0.0
         (counter if counter is not None
          else self._c_requests_failed).inc()
         with self._cond:
@@ -2382,7 +3008,15 @@ class GenerationEngine:
                         f"block allocation failed "
                         f"({type(e).__name__}: {e})"))
             if not self._live:
+                self._last_dispatch_t = 0.0
                 return
+        # decode-stall accounting: slots that survived the previous
+        # shared dispatch experienced everything since its end —
+        # monolithic prefills, prefill chunks, admissions — as stall;
+        # chunked prefill exists to bound this histogram's tail
+        if self._last_dispatch_t:
+            self._h_decode_stall.observe(
+                time.perf_counter() - self._last_dispatch_t)
         use_verify = any(s.draft for s in self._live.values())
         if use_verify:
             self._c_spec_proposed.inc(
@@ -2398,6 +3032,7 @@ class GenerationEngine:
             t0 = time.perf_counter()
             logits = self._dispatch_decode(feats)
         if logits is None:
+            self._last_dispatch_t = 0.0
             return
         self._retry.observe(time.perf_counter() - t0)
         with self.registry.atomic():
@@ -2488,6 +3123,10 @@ class GenerationEngine:
             self._retry.dispatches_for(
                 min(s.remaining_steps() for s in live)) if live
             else 1.0)
+        # stamp this dispatch's end while anyone is still decoding —
+        # the next dispatch's stall sample starts here (0 = nobody
+        # carries across, no sample)
+        self._last_dispatch_t = time.perf_counter() if live else 0.0
 
     # ---- observability ----------------------------------------------
     @snapshot_view
@@ -2497,9 +3136,13 @@ class GenerationEngine:
         counter values can never disagree about the same instant, and
         a concurrent scheduler mutation can never be observed torn:
         grouped updates hold the registry lock the snapshot takes)."""
+        now = time.perf_counter()
         with self._cond:
             self._g_queue_depth.set(len(self._queue))
             self._g_live_slots.set(len(self._live))
+            self._g_prefilling_slots.set(len(self._prefilling))
+            self._g_queue_age.set(round(self._queue_age_s(now), 4))
+        self._g_pressure_level.set(self._pressure_level)
         with self.registry.atomic():
             proposed = self._c_spec_proposed.value
             self._g_accept_rate.set(
@@ -2558,6 +3201,21 @@ class GenerationEngine:
             "spec_accepted": c("serving_spec_accepted_total"),
             "spec_emitted": c("serving_spec_emitted_total"),
             "accept_rate": c("serving_spec_accept_rate"),
+            # SLO-aware overload resilience (round 18): the shedding /
+            # pressure / chunked-prefill story at a glance
+            "pressure": PRESSURE_STATES[self._pressure_level],
+            "pressure_level": c("serving_pressure_level"),
+            "pressure_transitions": c(
+                "serving_pressure_transitions_total"),
+            "queue_age_s": c("serving_queue_age_seconds"),
+            "shed": c("serving_shed_total"),
+            "shed_interactive": c("serving_shed_interactive_total"),
+            "shed_batch": c("serving_shed_batch_total"),
+            "shed_best_effort": c("serving_shed_best_effort_total"),
+            "shed_infeasible": c("serving_shed_infeasible_total"),
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
+            "prefill_chunks": c("serving_prefill_chunks_total"),
+            "prefilling_slots": c("serving_prefilling_slots"),
             "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
             "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),

@@ -112,6 +112,24 @@ identical boundaries). ``/stats`` and ``/metrics`` carry
 ``accept_rate`` and the ``serving_spec_*`` counters; each response's
 ``timings`` rows carry ``spec_accepted``.
 
+SLO-aware overload resilience (round 18): ``--prefill_chunk_tokens C``
+arms chunked prefill over artifacts exported with
+``export_generator(..., prefill_chunk=C)`` (auto-off with a warning
+otherwise — byte-identical greedy output either way);
+``--default_priority`` and the per-request ``priority`` payload knob
+(``interactive`` | ``batch`` | ``best_effort``) order the admission
+queue (class, earliest deadline, FIFO, aging); ``--shed_policy auto``
+runs the brownout ladder plus the deadline-feasibility shed — shed
+requests answer 429 with a MEASURED ``Retry-After``
+(:class:`~.serving_batch.ShedError` is a ``QueueFullError``, so the
+existing 429 mapping carries it), never a timeout. ``GET /healthz``
+now publishes the saturation fields (``queue_age_s`` /
+``queue_limit`` / ``pressure`` / ``saturated``) the fleet router uses
+to demote an overloaded-but-live replica to ``degraded`` before it
+starts mass-shedding; ``/stats`` and ``/metrics`` carry the
+``serving_shed_*`` / pressure / chunk counters and the
+``serving_decode_stall_seconds`` histogram.
+
 Fleet (round 15): N of these servers sit behind
 :class:`~.serving_router.ReplicaRouter` — ``/healthz`` (live/stalled/
 draining) drives the router's replica state machine, ``POST
@@ -187,6 +205,10 @@ class PredictServer:
                  drain_timeout_s: float = 30.0,
                  stall_after_s: float = 10.0,
                  spec_tokens: int = 0,
+                 prefill_chunk_tokens: int = 0,
+                 default_priority: str = "interactive",
+                 shed_policy: str = "auto",
+                 priority_aging_ms: int = 2000,
                  process_name: str | None = None,
                  flight_recorder: bool = True,
                  incident_dir: str | None = None):
@@ -253,6 +275,9 @@ class PredictServer:
                         "drain_timeout_s": drain_timeout_s,
                         "stall_after_s": stall_after_s,
                         "spec_tokens": spec_tokens,
+                        "prefill_chunk_tokens": prefill_chunk_tokens,
+                        "default_priority": default_priority,
+                        "shed_policy": shed_policy,
                         "export_dir": export_dir,
                         "model": self.name},
                 request_log_path=request_log,
@@ -315,6 +340,31 @@ class PredictServer:
                         "exported verify width %d — clamping to %d",
                         spec_tokens, sw.spec_tokens, sw.spec_tokens)
                     spec_tokens = sw.spec_tokens
+                if prefill_chunk_tokens \
+                        and not sw.prefill_chunk_tokens:
+                    # auto-off, same contract as --spec_tokens: the
+                    # knob asks for an optimization this artifact
+                    # cannot run — serve without it (loudly) rather
+                    # than refuse traffic
+                    from .utils.logging import get_logger
+                    get_logger("serving").warning(
+                        "--prefill_chunk_tokens %d requested but %r "
+                        "carries no chunked-prefill program (exported "
+                        "without prefill_chunk) — chunked prefill "
+                        "disabled for this server; re-export with "
+                        "export_generator(..., prefill_chunk=C) to "
+                        "enable it", prefill_chunk_tokens, export_dir)
+                    prefill_chunk_tokens = 0
+                elif prefill_chunk_tokens > sw.prefill_chunk_tokens \
+                        and sw.prefill_chunk_tokens:
+                    from .utils.logging import get_logger
+                    get_logger("serving").warning(
+                        "--prefill_chunk_tokens %d exceeds this "
+                        "artifact's exported chunk width %d — "
+                        "clamping to %d", prefill_chunk_tokens,
+                        sw.prefill_chunk_tokens,
+                        sw.prefill_chunk_tokens)
+                    prefill_chunk_tokens = sw.prefill_chunk_tokens
                 self.engine = GenerationEngine(
                     sw, max_queue=max_queue,
                     prefix_cache=prefix_cache, registry=self.registry,
@@ -324,8 +374,21 @@ class PredictServer:
                     drain_timeout_s=drain_timeout_s,
                     stall_after_s=stall_after_s,
                     spec_tokens=spec_tokens,
+                    prefill_chunk_tokens=prefill_chunk_tokens,
+                    default_priority=default_priority,
+                    shed_policy=shed_policy,
+                    priority_aging_ms=priority_aging_ms,
                     process=self.process_name,
                     flight_recorder=self._flightrec).start()
+                if self._flightrec is not None:
+                    # the recorder's config block was snapshotted with
+                    # the REQUESTED knobs; the auto-off/clamp logic
+                    # above may have changed what actually runs — an
+                    # incident bundle must name the effective values
+                    self._flightrec.config.update({
+                        "spec_tokens": self.engine.spec_tokens,
+                        "prefill_chunk_tokens":
+                            self.engine.prefill_chunk_tokens})
             else:
                 self.batcher = MicroBatcher(
                     self.servable, batch_max_size=batch_max_size,
@@ -566,6 +629,16 @@ class PredictServer:
               # of drafting, 2..--spec_tokens caps it (absent = the
               # server default; >0 on a spec-off server is a 400)
               "spec_tokens": knob("spec_tokens", int)}
+        prio = payload.get("priority")
+        if prio is not None:
+            # string knob (interactive|batch|best_effort): the value
+            # set is validated in the engine's _make_request on this
+            # handler thread — a bad class is a clean 400 naming the
+            # choices; the type check here keeps the error readable
+            if not isinstance(prio, str):
+                raise ValueError(
+                    f"'priority' must be a string, got {prio!r}")
+            kw["priority"] = prio
         stop = payload.get("stop_sequences")
         if stop is not None:
             # shape/type validation happens in the engine's
@@ -1092,6 +1165,36 @@ def main(argv=None) -> int:
                     "stays byte-identical; 0 = off (bitwise no-op). "
                     "Per-request `spec_tokens` in the payload opts out "
                     "(0) or caps lower")
+    ap.add_argument("--prefill_chunk_tokens", type=int, default=0,
+                    help="chunked prefill: feed cold prompts to the "
+                    "engine in block-aligned chunks of this many "
+                    "tokens per scheduler iteration, interleaved with "
+                    "shared decode steps, so a long prompt can never "
+                    "stall live decoders for a whole monolithic "
+                    "prefill (needs an artifact exported with "
+                    "export_generator(..., prefill_chunk=C); auto-off "
+                    "with a warning when the artifact lacks the chunk "
+                    "program). Greedy bytes stay byte-identical; 0 = "
+                    "off (bitwise no-op)")
+    ap.add_argument("--default_priority",
+                    choices=("interactive", "batch", "best_effort"),
+                    default="interactive",
+                    help="admission class for :generate requests that "
+                    "carry no 'priority' of their own — orders the "
+                    "queue (class, then earliest deadline, then FIFO, "
+                    "with aging so best_effort never starves) and "
+                    "names the brownout rung that sheds the request "
+                    "under overload")
+    ap.add_argument("--shed_policy", choices=("auto", "off"),
+                    default="auto",
+                    help="graceful load shedding: 'auto' runs the "
+                    "pressure ladder (healthy -> shed_best_effort -> "
+                    "shed_batch -> interactive_only; 429 + measured "
+                    "Retry-After per shed class) plus the deadline-"
+                    "feasibility shed (a queued request that can no "
+                    "longer meet its deadline_ms is 429'd immediately "
+                    "instead of 504ing later); 'off' keeps only the "
+                    "blunt queue-full 429")
     ap.add_argument("--stall_after_s", type=float, default=10.0,
                     help="GET /healthz reports 'stalled' (503) once the "
                     "scheduler heartbeat is older than this")
@@ -1134,6 +1237,9 @@ def main(argv=None) -> int:
                         drain_timeout_s=args.drain_timeout_s,
                         stall_after_s=args.stall_after_s,
                         spec_tokens=args.spec_tokens,
+                        prefill_chunk_tokens=args.prefill_chunk_tokens,
+                        default_priority=args.default_priority,
+                        shed_policy=args.shed_policy,
                         flight_recorder=args.flight_recorder == "on",
                         incident_dir=args.incident_dir)
 

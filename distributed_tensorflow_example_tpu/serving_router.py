@@ -10,7 +10,14 @@ client-facing address with fleet semantics:
 
 - **Health-driven replica states** — a probe thread polls each
   replica's ``GET /healthz`` (the PR-10 watchdog surface) and runs a
-  per-replica state machine::
+  per-replica state machine (round 18: a 200 probe whose body reports
+  ``saturated: true`` — the replica's own brownout ladder at
+  shed_batch or deeper, per its queue-depth/queue-age saturation
+  fields — demotes a LIVE replica to the distinct ``saturated``
+  state: preferred-last rather than inadmissible, so one overloaded
+  backend drains while the rest carry its traffic, but a fleet-wide
+  overload still reaches the replicas' own class ladders instead of
+  collapsing into a blanket router 503)::
 
         unknown ──200──> healthy <──────────────┐
            │               │  ▲                 │ probe 200
@@ -137,6 +144,13 @@ log = get_logger("router")
 
 #: replica states a request may be routed to
 ADMISSIBLE_STATES = ("healthy",)
+
+#: round 18: live-but-brownout replicas (healthz ``saturated: true``)
+#: — routed to ONLY when no healthy replica is left, so a single
+#: saturated backend drains while the rest carry its traffic, but a
+#: fleet-wide overload degrades by CLASS at the replicas' own ladders
+#: instead of becoming a blanket router 503 for everyone
+LAST_RESORT_STATES = ("saturated",)
 
 
 class ForwardError(Exception):
@@ -477,6 +491,23 @@ class ReplicaRouter:
             # breaker-worthy failure
             self._set_state(r, "draining")
             return
+        if status == 200 and body.get("saturated"):
+            # round 18: the replica is LIVE but its own pressure
+            # ladder says it is deep in brownout (queue-age/depth
+            # saturation fields in /healthz) — demote to SATURATED so
+            # new admissions prefer other replicas BEFORE this one has
+            # to mass-shed them. Distinct from "degraded" (engine
+            # stalled/dead behind a live listener): a saturated
+            # replica still SERVES, so it stays the last-resort tier
+            # in _pick — under fleet-wide overload interactive traffic
+            # keeps flowing to the replicas' own class ladders instead
+            # of collapsing into a blanket router 503. NOT a
+            # breaker-worthy failure; the next unsaturated 200 probe
+            # re-admits it.
+            if r.breaker.state != "closed" and r.breaker.allow():
+                r.breaker.record_success()
+            self._set_state(r, "saturated")
+            return
         if status == 200:
             # the half-open recovery probe: a live replica after the
             # cooldown closes its breaker (forward failures re-open)
@@ -529,26 +560,37 @@ class ReplicaRouter:
         request's remaining budget is never picked. A replica whose
         breaker is open joins only as the half-open trial carrier —
         preferred LAST, and its probe slot is consumed only when it
-        is actually picked."""
+        is actually picked. SATURATED replicas (live, brownout) are
+        the tier after that: picked only when no healthy replica is
+        left, so fleet-wide overload still reaches the replicas' own
+        class ladders instead of 503ing every request at the
+        router."""
         states = self.replica_states()
         with self._lock:
             outstanding = dict(self._outstanding)
-        closed, trial = [], []
+        closed, trial, last_resort = [], [], []
         for i, r in enumerate(self.replicas):
             if r.name in excluded:
                 continue
-            if states.get(r.name) not in ADMISSIBLE_STATES:
+            state = states.get(r.name)
+            if state not in ADMISSIBLE_STATES \
+                    and state not in LAST_RESORT_STATES:
                 continue
             if remaining_ms is not None and \
                     r.wait_hint_s(outstanding[r.name]) * 1e3 \
                     > remaining_ms:
                 continue
-            (closed if r.breaker.state == "closed" else trial).append(
-                (outstanding[r.name], i, r))
+            bucket = (last_resort if state in LAST_RESORT_STATES
+                      else closed if r.breaker.state == "closed"
+                      else trial)
+            bucket.append((outstanding[r.name], i, r))
         if closed:
             return min(closed)[2]
         for _, _, r in sorted(trial):
             if r.breaker.allow():         # takes the half-open slot
+                return r
+        for _, _, r in sorted(last_resort):
+            if r.breaker.state == "closed" or r.breaker.allow():
                 return r
         return None
 
@@ -1096,13 +1138,18 @@ class ReplicaRouter:
     # ---- observability -----------------------------------------------
     def fleet_health(self) -> dict:
         """``GET /healthz``: 200-worthy while at least one replica is
-        admissible."""
+        admissible; ``saturated`` (503 — upstream pushback) while only
+        last-resort replicas remain, though requests still route to
+        them."""
         states = self.replica_states()
         with self._lock:
             outstanding = dict(self._outstanding)
         live = sum(1 for s in states.values() if s in ADMISSIBLE_STATES)
+        saturated = sum(1 for s in states.values()
+                        if s in LAST_RESORT_STATES)
         return {
-            "status": "live" if live else "unserved",
+            "status": ("live" if live
+                       else "saturated" if saturated else "unserved"),
             "replicas": {
                 r.name: {"url": r.url, "state": states[r.name],
                          "breaker": r.breaker.state,

@@ -44,6 +44,20 @@ round-14 contract:
                          honor 429/Retry-After semantics in a retry
                          loop: every request eventually lands, bytes
                          to parity.
+- ``overload_storm``   — ~2x sustainable offered load (round 18):
+                         every admitted interactive request finishes
+                         within its deadline to byte parity with zero
+                         failures, best_effort is shed 429-class with
+                         a measured Retry-After once the pressure
+                         ladder leaves healthy, shed accounting is
+                         exact, and pressure/blocks recover after.
+- ``long_prompt_storm``— chunked prefill (round 18): full-length
+                         prompts admit chunk by chunk while a live
+                         short decoder keeps stepping — the dispatch
+                         order proves decode steps interleave between
+                         one prompt's chunks, bytes match the
+                         chunk-off engine exactly, chunk accounting
+                         is exact, blocks recover.
 - ``spec_verify_fault``— a seeded ``engine.decode_step`` fault lands
                          DURING a K-token speculative verify dispatch
                          (round 16): the transient heals via the same
@@ -160,7 +174,9 @@ def counters(eng) -> dict:
     return {k: v(k) for k in (
         "serving_requests_failed_total", "serving_cancelled_total",
         "serving_deadline_expired_total", "serving_redispatches_total",
-        "serving_drain_ms")}
+        "serving_drain_ms", "serving_shed_total",
+        "serving_shed_infeasible_total", "serving_prefill_chunks_total",
+        "serving_pressure_transitions_total")}
 
 
 def _wait(pred, timeout=30.0, what="condition"):
@@ -177,8 +193,14 @@ def _wait(pred, timeout=30.0, what="condition"):
 # ---------------------------------------------------------------------------
 
 def scenario_deadline_storm(d: str, seed: int, vocab: int):
-    from distributed_tensorflow_example_tpu.serving_batch import \
-        DeadlineExceededError
+    """Round-18 note: a 1 ms deadline can now be SHED (429-class
+    ShedError, deadline infeasible at the measured rate) instead of
+    expiring into the 504-class DeadlineExceededError once the
+    engine's decode EMA has a signal — both are the fail-fast-and-
+    return-blocks outcome this storm pins, so either counts; the
+    accounting assertion covers their sum."""
+    from distributed_tensorflow_example_tpu.serving_batch import (
+        DeadlineExceededError, ShedError)
     prompts = seeded_prompts(2 * SLOTS, seed, vocab)
     tight, loose = prompts[::2], prompts[1::2]
     ref = reference_run(d, loose, max_new=6)
@@ -192,14 +214,16 @@ def scenario_deadline_storm(d: str, seed: int, vocab: int):
                                           deadline_ms=1))
             else:
                 handles.append(eng.submit(prompts[i], max_new=6))
-        expired = survived = 0
+        expired = shed = survived = 0
         for i, h in enumerate(handles):
             if i % 2 == 0:
                 try:
                     h.result(timeout=120)
                     raise AssertionError(
                         f"1 ms-deadline request {h.request_id} was "
-                        "never expired")
+                        "never expired or shed")
+                except ShedError:
+                    shed += 1
                 except DeadlineExceededError:
                     expired += 1
             else:
@@ -211,8 +235,10 @@ def scenario_deadline_storm(d: str, seed: int, vocab: int):
               what="exact blocks_free recovery")
         met = counters(eng)
         assert met["serving_deadline_expired_total"] == expired, met
-        return (f"{expired} expired (504-class), {survived} survivors "
-                f"to byte parity, blocks_free recovered to {free0}",
+        assert met["serving_shed_infeasible_total"] == shed, met
+        return (f"{expired} expired (504-class) + {shed} shed "
+                f"(429-class feasibility), {survived} survivors to "
+                f"byte parity, blocks_free recovered to {free0}",
                 met)
     finally:
         eng.close()
@@ -556,6 +582,145 @@ def scenario_spec_verify_fault(d: str, seed: int, vocab: int):
             "and exact pos/blocks recovery", met_p)
 
 
+def scenario_overload_storm(d: str, seed: int, vocab: int):
+    """Round-18 overload gate: ~2x sustainable offered load against a
+    small admission queue. Every ADMITTED interactive request finishes
+    within its (generous) deadline with zero client-visible failures
+    and byte parity; once the pressure ladder leaves healthy,
+    best_effort submissions are shed with 429-class ShedError carrying
+    a measured Retry-After (never a timeout); the shed accounting is
+    exact per class; and pressure returns to healthy with blocks_free
+    recovered exactly once the storm drains."""
+    from distributed_tensorflow_example_tpu.serving_batch import (
+        QueueFullError, ShedError)
+    prompts = seeded_prompts(3 * SLOTS, seed + 8, vocab)
+    ref = reference_run(d, prompts, max_new=8)
+    eng = fresh_engine(d, max_queue=3 * SLOTS)
+    try:
+        free0 = eng.stats()["blocks_free"]
+        # the interactive base load: 3x the slot count, generous
+        # deadlines — the class the ladder protects
+        handles = [eng.submit(p, max_new=8, deadline_ms=120_000)
+                   for p in prompts]
+        _wait(lambda: eng._pressure_level >= 1,
+              what="pressure ladder leaving healthy under backlog")
+        shed = 0
+        retry_afters = []
+        probe = seeded_prompts(1, seed + 9, vocab)[0]
+        probe_handles = []
+        for _ in range(200):
+            try:
+                probe_handles.append(
+                    eng.submit(probe, max_new=2,
+                               priority="best_effort"))
+            except ShedError as e:
+                shed += 1
+                retry_afters.append(e.retry_after)
+                if shed >= 3:
+                    break
+            except QueueFullError:
+                pass        # full below the ladder: plain pushback
+            time.sleep(0.002)
+        assert shed > 0, "the ladder never shed best_effort traffic"
+        assert all(ra >= 0.0 for ra in retry_afters), retry_afters
+        for i, h in enumerate(handles):
+            toks = h.result(timeout=120)
+            assert toks == ref[i], \
+                f"interactive request {i} diverged under overload"
+        for h in probe_handles:     # admitted below the ladder: fine
+            try:
+                h.result(timeout=120)
+            except ShedError:
+                # admitted at healthy, then swept by a later
+                # interactive_only rung while still queued — the same
+                # 429-class outcome, counted in the same ledger
+                shed += 1
+        _wait(lambda: eng.stats()["blocks_free"] == free0,
+              what="exact blocks_free recovery")
+        _wait(lambda: eng.stats()["pressure"] == "healthy",
+              what="pressure returning to healthy after the storm")
+        met = counters(eng)
+        st = eng.stats()
+        assert met["serving_shed_total"] == shed, (met, shed)
+        assert st["shed_best_effort"] == shed, st
+        assert met["serving_deadline_expired_total"] == 0, met
+        assert met["serving_requests_failed_total"] == 0, met
+        assert met["serving_pressure_transitions_total"] >= 2, met
+        return (f"{len(handles)} interactive requests to byte parity "
+                f"with zero failures under 2x load; {shed} "
+                f"best_effort shed 429-class with measured "
+                f"Retry-After; pressure healthy again, blocks "
+                f"recovered to {free0}", met)
+    finally:
+        eng.close()
+
+
+def scenario_long_prompt_storm(d_unused: str, seed: int,
+                               vocab_unused: int):
+    """Round-18 chunked-prefill gate: a live short decoder keeps
+    decoding WHILE a wave of full-length prompts admits chunk by chunk
+    — the dispatch order proves decode steps interleave between a
+    single prompt's chunks (impossible with the monolithic prefill),
+    greedy bytes stay byte-identical to the chunk-off engine over the
+    same export, the chunk accounting is exact, and blocks_free
+    recovers."""
+    from serving_load import build_export
+    rs = np.random.RandomState(seed + 10)
+    with tempfile.TemporaryDirectory() as ds:
+        # its own export: the shared scenario artifact carries no
+        # chunk program; 16-token prompts over 4-token blocks = 4
+        # chunks per long admission
+        pl = 16
+        vocab = build_export(ds, prompt_len=pl, max_new=MAX_NEW,
+                             slots=SLOTS, seed=seed, paged=True,
+                             block_size=BLOCK, prefill_chunk=BLOCK,
+                             num_blocks=1 + 4 * SLOTS
+                             * -(-(pl + MAX_NEW) // BLOCK))
+        long_prompts = [rs.randint(0, vocab, (pl,)).astype(np.int32)
+                        for _ in range(2)]
+        short = rs.randint(0, vocab, (3,)).astype(np.int32)
+
+        def run(chunk, wrap=False):
+            eng = fresh_engine(ds, prefill_chunk_tokens=chunk)
+            order: list[str] = []
+            if wrap:
+                od, oc = eng.sw.decode, eng.sw.prefill_chunk
+                eng.sw.decode = \
+                    lambda f: (order.append("decode"), od(f))[1]
+                eng.sw.prefill_chunk = \
+                    lambda f: (order.append("chunk"), oc(f))[1]
+            try:
+                free0 = eng.stats()["blocks_free"]
+                h0 = eng.submit(short, max_new=MAX_NEW)
+                _wait(lambda: eng.stats()["live_slots"] >= 1,
+                      what="the short decoder going live")
+                hs = [eng.submit(p, max_new=4) for p in long_prompts]
+                outs = [h.result(timeout=120) for h in [h0, *hs]]
+                _wait(lambda: eng.stats()["blocks_free"] == free0,
+                      what="exact blocks_free recovery")
+                return outs, counters(eng), order
+            finally:
+                eng.close()
+
+        ref, met0, _ = run(0)
+        outs, met1, order = run(BLOCK, wrap=True)
+    assert outs == ref, \
+        "chunked admission diverged from the monolithic prefill"
+    assert met0["serving_prefill_chunks_total"] == 0, met0
+    # short prompt: 1 chunk; each long prompt: pl/BLOCK chunks
+    want = 1 + 2 * (pl // BLOCK)
+    assert met1["serving_prefill_chunks_total"] == want, (met1, want)
+    first, last = order.index("chunk"), len(order) - 1 - \
+        order[::-1].index("chunk")
+    interleaved = "decode" in order[first:last]
+    assert interleaved, \
+        f"no decode step ever ran between prefill chunks: {order}"
+    assert met1["serving_requests_failed_total"] == 0, met1
+    return (f"{want} chunk dispatches interleaved with shared decode "
+            f"steps (order window {order[first:last + 1][:12]}...), "
+            "all bytes to chunk-off parity, blocks recovered", met1)
+
+
 SCENARIOS = {
     "deadline_storm": scenario_deadline_storm,
     "poison_step": scenario_poison_step,
@@ -565,6 +730,8 @@ SCENARIOS = {
     "watchdog_trip": scenario_watchdog_trip,
     "queue_full_retry": scenario_queue_full_retry,
     "spec_verify_fault": scenario_spec_verify_fault,
+    "overload_storm": scenario_overload_storm,
+    "long_prompt_storm": scenario_long_prompt_storm,
 }
 
 #: scenarios that need the deliberately under-provisioned block pool

@@ -82,6 +82,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -202,7 +203,7 @@ def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                  block_size: int = 16, num_blocks=None,
                  weight_quant: str = "off",
                  kv_cache_dtype: str = "auto", pool_bytes=None,
-                 spec_tokens: int = 0):
+                 spec_tokens: int = 0, prefill_chunk: int = 0):
     """Seeded GPT stepwise export (ragged monolithic artifact too, so
     the off path serves the same mixed prompt lengths). ``platforms``
     includes "tpu" when bench.py runs the serving row on chip;
@@ -223,6 +224,7 @@ def build_export(out_dir: str, *, prompt_len: int, max_new: int,
                      weight_quant=weight_quant,
                      kv_cache_dtype=kv_cache_dtype,
                      pool_bytes=pool_bytes, spec_tokens=spec_tokens,
+                     prefill_chunk=prefill_chunk,
                      platforms=tuple(platforms))
     return model.cfg.vocab_size
 
@@ -602,6 +604,254 @@ def int8_capacity_check(*, prompt_len: int, max_new: int, seed: int,
     return counts["bf16"], counts["int8"]
 
 
+def chunk_stall_probe(*, seed: int = 0, prompt_len: int = 512,
+                      block_size: int = 32, max_new: int = 16,
+                      storms: int = 2) -> dict:
+    """THE decode-stall-under-long-prompt probe (round 18): a
+    long-context GPT (the tiny smoke model's prefill is too cheap to
+    stall anything) serves a live short decoder while full-length
+    prompts admit mid-stream, chunked OFF vs ON over the same export.
+    Measures the gap between consecutive shared decode dispatches as
+    the live decoder experiences it (direct engine drive, warmed
+    first — compile time must not masquerade as stall) and returns
+    both modes' p95/max-stall plus wall time. The gated figure is the
+    WORST-CASE stall: monolithic admission stalls the decoder for one
+    whole prompt forward, chunked for at most one chunk dispatch —
+    the structural bound chunked prefill exists for. ``storms`` runs
+    per mode take the min-of-max (OS jitter must not fail the gate).
+    Byte parity between the modes is asserted inside."""
+    import tempfile as _tf
+
+    import jax
+    from distributed_tensorflow_example_tpu.models.gpt import (GPT,
+                                                               GPTConfig)
+    from distributed_tensorflow_example_tpu.serving import (
+        export_generator, load_stepwise)
+    from distributed_tensorflow_example_tpu.serving_batch import \
+        GenerationEngine
+
+    cfg = GPTConfig(vocab_size=512, hidden=128, layers=2, heads=4,
+                    intermediate=256,
+                    max_len=prompt_len + max(max_new, 192))
+    model = GPT(cfg)
+    params = model.init(jax.random.key(seed))
+    rs = np.random.RandomState(seed)
+    long_prompts = [rs.randint(0, cfg.vocab_size,
+                               (prompt_len,)).astype(np.int32)
+                    for _ in range(3)]
+    short = rs.randint(0, cfg.vocab_size, (4,)).astype(np.int32)
+    with _tf.TemporaryDirectory() as d:
+        export_generator(model, params, d, prompt_len=prompt_len,
+                         max_new_tokens=192, batch_size=1,
+                         ragged=True, stepwise=True, slots=4,
+                         paged=True, block_size=block_size,
+                         prefill_chunk=block_size, platforms=("cpu",))
+
+        def run(chunk):
+            # prefix cache OFF: the warmup request would otherwise
+            # cache the long prompt and turn the storm's admissions
+            # into prefill-free cache hits — the A/B must measure the
+            # PREFILL stall it exists to compare
+            eng = GenerationEngine(load_stepwise(d),
+                                   prefix_cache=False,
+                                   prefill_chunk_tokens=chunk).start()
+            od = eng.sw.decode
+            gaps: list[float] = []
+            last = [0.0]
+
+            def wrapped(feats):
+                t = time.perf_counter()
+                if last[0]:
+                    gaps.append(t - last[0])
+                out = od(feats)
+                last[0] = time.perf_counter()
+                return out
+
+            try:
+                # warm every program (prefill or chunks + decode)
+                eng.submit(long_prompts[0],
+                           max_new=2).result(timeout=600)
+                # the witness decoder: deep enough max_new to stay
+                # live through every storm — its inter-dispatch gaps
+                # ARE the stall measurement
+                witness = eng.submit(short, max_new=192)
+                t_w = time.monotonic()
+                while eng.stats()["live_slots"] < 1 \
+                        and time.monotonic() - t_w < 60:
+                    time.sleep(0.002)
+                eng.sw.decode = wrapped
+                # gaps accumulate across every storm: the witness
+                # keeps decoding between storms, so inter-storm gaps
+                # are ordinary ~ms decode cadence, not idle time
+                gaps.clear()
+                last[0] = 0.0
+                outs, wall, lived = [], 0.0, True
+                t_all = time.perf_counter()
+                for _ in range(storms):
+                    hs = [eng.submit(p, max_new=2)
+                          for p in long_prompts]
+                    outs = [h.result(timeout=600) for h in hs]
+                    lived = lived and not witness.done()
+                wall = time.perf_counter() - t_all
+                from distributed_tensorflow_example_tpu.serving_batch \
+                    import percentile
+                witness.cancel()
+                return {"outs": outs,
+                        "witness_lived": lived,
+                        "stall_p95_ms": round(
+                            percentile(gaps, 95) * 1e3, 2),
+                        "stall_max_ms": round(
+                            (max(gaps) if gaps else 0.0) * 1e3, 2),
+                        "wall_s": round(wall, 3)}
+            finally:
+                eng.close()
+
+        off, on = run(0), run(block_size)
+    parity = (off.pop("outs") == on.pop("outs")
+              and off.pop("witness_lived") and on.pop("witness_lived"))
+    return {"off": off, "on": on, "parity": parity,
+            "prompt_len": prompt_len, "chunk": block_size}
+
+
+def run_overload(export_dir: str, *, vocab: int, seed: int,
+                 prompt_len: int, max_new: int = 4,
+                 max_queue: int = 3,
+                 interactive_clients: int = 4, requests: int = 3,
+                 deadline_ms: int = 60_000) -> dict:
+    """The overload leg (round 18): ~2x sustainable offered load — a
+    closed-loop INTERACTIVE base load that keeps the small admission
+    queue deep, plus a best_effort poster hammering beside it. The
+    brownout ladder must shed the best_effort traffic with 429 + a
+    Retry-After header while EVERY admitted interactive request
+    finishes inside its (generous) deadline with zero client-visible
+    failures — shed requests are told when to come back, never left
+    to time out."""
+    from distributed_tensorflow_example_tpu.serving_http import \
+        PredictServer
+
+    rs = np.random.RandomState(seed)
+    lat: list[float] = []
+    errors: list[str] = []
+    shed_429: list[str] = []          # Retry-After header per SHED 429
+    queue_full_429 = [0]              # blunt-bound 429s (not sheds)
+    missing_retry_after = [0]
+    with PredictServer(export_dir, max_queue=max_queue) as srv:
+        stop = threading.Event()
+
+        def interactive(ci):
+            for _ in range(requests):
+                prompt = rs.randint(0, vocab,
+                                    (prompt_len,)).astype(np.int32)
+                t0 = time.perf_counter()
+                for _attempt in range(100):
+                    try:
+                        _post(srv.port, srv.name, "generate",
+                              {"inputs": {"input_ids":
+                                          [prompt.tolist()]},
+                               "max_new": max_new,
+                               "deadline_ms": deadline_ms,
+                               "priority": "interactive"})
+                        lat.append(time.perf_counter() - t0)
+                        break
+                    except urllib.error.HTTPError as e:
+                        if e.code == 429:
+                            # queue-full pushback: a closed-loop
+                            # client honors Retry-After and retries —
+                            # interactive is never CLASS-shed, so
+                            # this is the blunt bound, not the ladder
+                            try:
+                                ra = float(e.headers.get(
+                                    "Retry-After", 0) or 0)
+                            except ValueError:
+                                ra = 0.0
+                            e.read()
+                            time.sleep(min(max(ra, 0.005), 0.05))
+                            continue
+                        errors.append(f"interactive {ci}: http "
+                                      f"{e.code}")
+                        return
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        errors.append(f"interactive {ci}: "
+                                      f"{type(e).__name__}: {e}")
+                        return
+                else:
+                    errors.append(f"interactive {ci}: retry budget "
+                                  "exhausted on 429s")
+                    return
+
+        def best_effort():
+            # hammer until the ladder sheds (bounded): a 429 carrying
+            # Retry-After is the success condition here
+            for _ in range(200):
+                if stop.is_set():
+                    return
+                try:
+                    _post(srv.port, srv.name, "generate",
+                          {"inputs": {"input_ids": [[1, 2]]},
+                           "max_new": 2, "priority": "best_effort"})
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        ra = e.headers.get("Retry-After")
+                        body = e.read().decode(errors="replace")
+                        # a class SHED names itself ("shedding ... /
+                        # shed while queued"); a blunt queue-full 429
+                        # is the pre-round-18 bound, not a shed — the
+                        # registry's serving_shed_total only counts
+                        # the former, so the client ledger must too
+                        if "shed" not in body:
+                            queue_full_429[0] += 1
+                        elif ra is None:
+                            missing_retry_after[0] += 1
+                        else:
+                            shed_429.append(ra)
+                    else:
+                        errors.append(f"best_effort: http {e.code}")
+                except Exception as e:      # noqa: BLE001 — recorded
+                    errors.append(f"best_effort: {type(e).__name__}: "
+                                  f"{e}")
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=interactive, args=(ci,))
+                   for ci in range(interactive_clients)]
+        be = threading.Thread(target=best_effort)
+        for t in threads:
+            t.start()
+        be.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        be.join()
+        stats = _stats(srv.port)["generate"]
+        registry = _prom(srv.port)
+    n = len(lat)
+    lat.sort()
+
+    def pctl(q):
+        if not lat:
+            return 0.0
+        return lat[min(n - 1, int(round(q / 100 * (n - 1))))] * 1e3
+
+    return {
+        "mode": "overload",
+        "interactive_requests": n,
+        "interactive_expected": interactive_clients * requests,
+        "errors": errors,
+        "latency_p95_ms": round(pctl(95), 2),
+        "deadline_ms": deadline_ms,
+        "shed_429": len(shed_429),
+        "queue_full_429": queue_full_429[0],
+        "missing_retry_after": missing_retry_after[0],
+        "shed_total": int(registry.get("serving_shed_total", 0)),
+        "shed_best_effort": int(registry.get(
+            "serving_shed_best_effort_total", 0)),
+        "deadline_expired": int(registry.get(
+            "serving_deadline_expired_total", 0)),
+        "pressure_transitions": int(registry.get(
+            "serving_pressure_transitions_total", 0)),
+        "pressure_final": stats["pressure"],
+    }
+
+
 def thread_sanitizer_check(export_dir: str, prompt) -> tuple[bool, str]:
     """The seeded THR01 violation probe: arm an engine's runtime
     thread sanitizer, let the scheduler thread take ownership (one
@@ -809,10 +1059,14 @@ def main(argv=None) -> int:
                              prompt_len=args.prompt_len)]
         if args.smoke:
             with tempfile.TemporaryDirectory() as dp:
+                # the paged smoke export also carries the chunked-
+                # prefill program: paged_cold serves it with the knob
+                # OFF (the bitwise-no-op leg), chunked_on with it ON
                 build_export(dp, prompt_len=args.prompt_len,
                              max_new=args.max_new, slots=args.slots,
                              seed=args.seed, paged=True,
                              block_size=args.block_size,
+                             prefill_chunk=args.block_size,
                              num_blocks=1 + 4 * args.slots
                              * -(-(args.prompt_len + args.max_new)
                                  // args.block_size))
@@ -842,6 +1096,23 @@ def main(argv=None) -> int:
                 shared_off = run_mode(dp, shared, scheduler="off",
                                       prompt_len=args.prompt_len,
                                       mode_name="shared_off")
+                # chunked-prefill leg (round 18): same cold matrix,
+                # chunking ON — byte parity with the scheduler-off
+                # oracle, chunk dispatches replacing every cold
+                # monolithic prefill
+                chunked_on = run_mode(
+                    dp, cold, scheduler="on",
+                    prompt_len=args.prompt_len,
+                    mode_name="chunked_on",
+                    server_kw={"prefill_chunk_tokens":
+                               args.block_size})
+                # overload leg (round 18): 2x offered load against a
+                # 4-deep queue — interactive protected, best_effort
+                # shed with 429 + Retry-After, shed accounting exact
+                overload_row = run_overload(
+                    dp, vocab=vocab, seed=args.seed,
+                    prompt_len=args.prompt_len,
+                    max_new=args.max_new)
             # the int8 leg: same cold matrix against a fully quantized
             # export (int8 weights + int8 KV pool) — gated on the
             # documented drift bound vs the bf16 oracle, plus the
@@ -943,7 +1214,26 @@ def main(argv=None) -> int:
             # 2-replica fleet — greedy bytes must not depend on which
             # replica serves (or on the router being in the path)
             router_row = run_router_mode(d, matrix, replicas=2)
-            rows += [paged_cold, paged_shared, shared_off, int8_row,
+            # the decode-stall probe (round 18): long-context A/B,
+            # chunked stall bounded at one chunk dispatch
+            stall = chunk_stall_probe(seed=args.seed)
+            extra_summary["chunk_stall_off_ms"] = \
+                stall["off"]["stall_max_ms"]
+            extra_summary["chunk_stall_on_ms"] = \
+                stall["on"]["stall_max_ms"]
+            extra_summary["chunk_stall_p95_off_ms"] = \
+                stall["off"]["stall_p95_ms"]
+            extra_summary["chunk_stall_p95_on_ms"] = \
+                stall["on"]["stall_p95_ms"]
+            # wall ratio reported, not gated: the per-dispatch overhead
+            # that dominates the tiny CPU probe amortizes away at real
+            # model sizes — the hardware window baselines the tps side
+            # (BASELINE.md decision-rule pattern, DESIGN.md §21)
+            extra_summary["chunk_wall_ratio"] = round(
+                stall["on"]["wall_s"] / stall["off"]["wall_s"], 3) \
+                if stall["off"]["wall_s"] else None
+            rows += [paged_cold, paged_shared, shared_off, chunked_on,
+                     overload_row, int8_row,
                      tsan_row, chaos_row, spec_off_row, spec_row,
                      flightrec_off_row, router_row]
             # always-on tps / recorder-off tps: ~1.0 expected (the
@@ -955,6 +1245,42 @@ def main(argv=None) -> int:
                 / flightrec_off_row["tokens_per_s"], 3) \
                 if flightrec_off_row["tokens_per_s"] else None
             checks += [
+                # round-18 gates: chunked prefill is exact and a
+                # provable no-op when off; overload degrades by class
+                # with honest pushback; the worst-case decode stall
+                # under a long-prompt storm is chunk-bounded
+                ("chunked_parity_with_off",
+                 chunked_on["_gens"] == cold_off_gens),
+                ("chunked_prefill_dispatches",
+                 chunked_on["registry"].get(
+                     "serving_prefill_chunks_total", 0) > 0),
+                ("chunk_noop_when_off",
+                 paged_cold["registry"].get(
+                     "serving_prefill_chunks_total", 0) == 0),
+                ("overload_interactive_zero_failures",
+                 not overload_row["errors"]
+                 and overload_row["interactive_requests"]
+                 == overload_row["interactive_expected"]),
+                ("overload_interactive_no_deadline_misses",
+                 overload_row["deadline_expired"] == 0),
+                ("overload_sheds_with_retry_after",
+                 overload_row["shed_429"] > 0
+                 and overload_row["missing_retry_after"] == 0),
+                ("overload_shed_accounting",
+                 overload_row["shed_total"]
+                 == overload_row["shed_429"] > 0),
+                ("overload_recovers_healthy",
+                 overload_row["pressure_final"] == "healthy"),
+                ("overload_p95_within_deadline",
+                 overload_row["latency_p95_ms"]
+                 <= overload_row["deadline_ms"]),
+                ("chunk_stall_parity", stall["parity"]),
+                ("chunk_stall_bounded_below_monolithic",
+                 stall["on"]["stall_max_ms"]
+                 < stall["off"]["stall_max_ms"]),
+                ("chunk_stall_p95_drops",
+                 stall["on"]["stall_p95_ms"]
+                 < stall["off"]["stall_p95_ms"]),
                 ("router_parity_with_single_replica",
                  router_row["_gens"] == rows[0]["_gens"]),
                 ("router_zero_client_failures",
@@ -1054,7 +1380,7 @@ def main(argv=None) -> int:
           and (agreement is None or agreement >= INT8_MIN_AGREEMENT)
           and all(v for _, v in checks))
     for row in rows:
-        row.pop("_gens")
+        row.pop("_gens", None)      # the overload row carries none
         print(json.dumps(row))
     on, off = rows[0], rows[1]
     summary = {

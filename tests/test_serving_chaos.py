@@ -156,6 +156,19 @@ def test_chaos_smoke_spec_verify_fault(chaos_dir):
         ["spec_verify_fault"], seed=0, export_dir=d, vocab=vocab))
 
 
+def test_chaos_smoke_overload_and_long_prompts(chaos_dir):
+    """Round-18: the overload storm (interactive protected to byte
+    parity at 2x load, best_effort shed 429-class with measured
+    Retry-After, exact shed accounting, pressure recovers) and the
+    long-prompt storm (chunked prefill interleaves shared decode steps
+    between one prompt's chunks, bytes identical to the chunk-off
+    engine, exact chunk accounting)."""
+    d, vocab = chaos_dir
+    _assert_ok(serving_chaos.run_scenarios(
+        ["overload_storm", "long_prompt_storm"],
+        seed=0, export_dir=d, vocab=vocab))
+
+
 @pytest.mark.slow
 def test_chaos_soak_cli_all_scenarios():
     """The full soak through the CLI entry (fresh process — the

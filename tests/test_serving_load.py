@@ -27,7 +27,8 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert summary[0]["greedy_parity"] is True
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
-                          "paged_shared", "shared_off", "int8_on",
+                          "paged_shared", "shared_off", "chunked_on",
+                          "overload", "int8_on",
                           "tsan_on", "chaos_on", "spec_off", "spec_on",
                           "flightrec_off", "router_on"}
     on = modes["scheduler_on"]
@@ -120,6 +121,32 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert router["fleet_registry_p95_ms"] > 0
     assert router["saturated_histograms"] == []
     assert not modes["flightrec_off"]["errors"]
+    # round-18 gates: chunked prefill is byte-exact and a provable
+    # no-op when off; the overload leg degrades by class with honest
+    # 429 + Retry-After pushback and a protected interactive class;
+    # the long-prompt decode stall is chunk-bounded (max AND p95 drop
+    # vs the monolithic baseline in the dedicated probe)
+    assert s["chunked_parity_with_off"] is True
+    assert s["chunked_prefill_dispatches"] is True
+    assert s["chunk_noop_when_off"] is True
+    chunked = modes["chunked_on"]
+    assert not chunked["errors"]
+    assert chunked["registry"]["serving_prefill_chunks_total"] > 0
+    assert chunked["registry"]["serving_prefills_total"] == 0
+    assert s["overload_interactive_zero_failures"] is True
+    assert s["overload_interactive_no_deadline_misses"] is True
+    assert s["overload_sheds_with_retry_after"] is True
+    assert s["overload_shed_accounting"] is True
+    assert s["overload_recovers_healthy"] is True
+    assert s["overload_p95_within_deadline"] is True
+    over = modes["overload"]
+    assert over["shed_429"] > 0 and over["missing_retry_after"] == 0
+    assert over["shed_best_effort"] > 0
+    assert over["deadline_expired"] == 0
+    assert s["chunk_stall_parity"] is True
+    assert s["chunk_stall_bounded_below_monolithic"] is True
+    assert s["chunk_stall_p95_drops"] is True
+    assert s["chunk_stall_on_ms"] < s["chunk_stall_off_ms"]
 
 
 def test_smoke_rejects_thread_sanitizer_flag(capsys):

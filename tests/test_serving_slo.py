@@ -1,0 +1,694 @@
+"""SLO-aware overload resilience (round 18): chunked prefill,
+priority admission, graceful shedding.
+
+- estimator-split unit tests: the decode-step EMA must be immune to
+  prefill-chunk observations (the satellite fix — Retry-After stays a
+  decode measurement under chunked prefill), and ``time_for`` prices
+  each work class by its own component;
+- pure-function tests for the ordered admission queue
+  (:func:`~.serving_batch.select_index`: class order, EDF within
+  class, FIFO ties, aging) including the deterministic injected-clock
+  NO-STARVATION bound — a sustained interactive stream can delay a
+  queued best_effort request only until aging promotes it;
+- the pressure ladder's hysteresis
+  (:func:`~.serving_batch.compute_pressure_level`);
+- engine-level chunked-prefill byte parity (chunking on vs off vs the
+  monolithic oracle) including the prefix-cache-hit, weight-int8 and
+  speculation compositions, the kv-int8 drift-gate composition, and
+  the ``prefill_chunk_tokens=0`` bitwise no-op (identical dispatch
+  counters);
+- brownout shedding by class (batch AND best_effort rungs), the
+  immediate feasibility shed (429-class ShedError, never a 504 after
+  wasted queue time), and the /healthz saturation fields;
+- the router-side satellite: a probe answering 200 with
+  ``saturated: true`` demotes an overloaded-but-live replica to
+  ``degraded`` (it stops taking admissions) and the next unsaturated
+  probe re-admits it.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "experiments"))
+
+import serving_load  # noqa: E402
+
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    load_stepwise)
+from distributed_tensorflow_example_tpu.serving_batch import (  # noqa: E402
+    PRESSURE_STATES, PRIORITIES, GenerationEngine, GenRequest,
+    RetryAfterEstimator, ShedError, compute_pressure_level,
+    select_index)
+from distributed_tensorflow_example_tpu.serving_router import (  # noqa: E402
+    ReplicaRouter)
+
+PROMPT_LEN = 12
+MAX_NEW = 8
+SLOTS = 3
+BLOCK = 4
+
+
+@pytest.fixture(scope="module")
+def chunk_dir(tmp_path_factory):
+    """ONE paged export carrying the chunked-prefill program, shared
+    by the engine-level tests (the shared-export pattern)."""
+    d = str(tmp_path_factory.mktemp("slo"))
+    vocab = serving_load.build_export(
+        d, prompt_len=PROMPT_LEN, max_new=MAX_NEW, slots=SLOTS,
+        seed=0, paged=True, block_size=BLOCK, prefill_chunk=BLOCK)
+    return d, vocab
+
+
+def _prompts(vocab, n, seed=0, lo=1, hi=PROMPT_LEN):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, vocab, (int(rs.randint(lo, hi + 1)),))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _run_engine(d, prompts, *, max_new=6, chunk=0, **kw):
+    eng = GenerationEngine(load_stepwise(d),
+                           prefill_chunk_tokens=chunk, **kw).start()
+    try:
+        handles = [eng.submit(p, max_new=max_new) for p in prompts]
+        outs = [h.result(timeout=120) for h in handles]
+        return outs, eng.stats()
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the split Retry-After estimator
+# ---------------------------------------------------------------------------
+
+def test_estimator_decode_ema_immune_to_prefill_chunks():
+    """The satellite fix pinned as math: chunk observations move ONLY
+    the prefill EMA — the decode-step EMA (and with it estimate(), the
+    queue-full Retry-After) is bitwise unchanged by any amount of
+    chunk work sharing the iteration."""
+    a, b = RetryAfterEstimator(alpha=0.5), RetryAfterEstimator(alpha=0.5)
+    for e in (a, b):
+        e.observe(0.010)
+        e.observe(0.020)
+    # b additionally sees heavy chunk traffic
+    for _ in range(50):
+        b.observe_prefill(0.500)
+    assert b.ema_step_s == a.ema_step_s
+    assert b.estimate(4.0, queue_ahead=3, slots=2) \
+        == a.estimate(4.0, queue_ahead=3, slots=2)
+    assert b.ema_prefill_chunk_s == pytest.approx(0.5, rel=1e-6)
+    assert a.ema_prefill_chunk_s is None
+
+
+def test_estimator_time_for_prices_both_components():
+    est = RetryAfterEstimator(alpha=1.0)
+    assert est.time_for(10) is None          # no decode signal yet
+    est.observe(0.010)
+    # no chunk signal: chunks priced at the decode EMA fallback
+    assert est.time_for(10) == pytest.approx(0.10)
+    assert est.time_for(10, prefill_chunks=2) == pytest.approx(0.12)
+    est.observe_prefill(0.100)
+    assert est.time_for(10, prefill_chunks=2) == pytest.approx(0.30)
+    # the tokens-per-dispatch EMA still converts row-steps (spec)
+    est.observe_advance(2.0)
+    assert est.time_for(10) == pytest.approx(0.010 * 10 / 2.0)
+
+
+def test_estimator_ema_step_alpha_unchanged():
+    """The pre-split observe() arithmetic is untouched (regression
+    guard for the PR-10 estimator tests' contract)."""
+    est = RetryAfterEstimator(alpha=0.2)
+    est.observe(1.0)
+    est.observe(2.0)
+    assert est.ema_step_s == pytest.approx(1.0 + 0.2 * 1.0)
+    assert est.seeded
+
+
+# ---------------------------------------------------------------------------
+# ordered admission: select_index
+# ---------------------------------------------------------------------------
+
+def _req(priority="interactive", submitted_at=0.0, deadline_t=0.0):
+    r = GenRequest(prompt=np.array([1], np.int32), max_new=4,
+                   temperature=0.0, top_k=0, top_p=0.0, seed=0,
+                   eos_id=None, pad_id=0)
+    r.priority = priority
+    r.submitted_at = submitted_at
+    r.deadline_t = deadline_t
+    return r
+
+
+def test_select_index_is_fifo_for_priorityless_traffic():
+    q = [_req(submitted_at=i) for i in range(5)]
+    assert select_index(q, now=100.0, aging_s=2.0) == 0
+
+
+def test_select_index_class_order_and_edf_within_class():
+    q = [_req("best_effort"), _req("batch"),
+         _req("interactive", deadline_t=50.0),
+         _req("interactive", deadline_t=20.0),
+         _req("interactive")]
+    # best class first; earliest deadline first inside it; a request
+    # with no deadline sorts after any deadline-carrying sibling
+    assert select_index(q, now=0.0, aging_s=0.0) == 3
+    del q[3]
+    assert select_index(q, now=0.0, aging_s=0.0) == 2
+    del q[2]
+    assert select_index(q, now=0.0, aging_s=0.0) == 2   # bare interactive
+    del q[2]
+    assert select_index(q, now=0.0, aging_s=0.0) == 1   # batch over b_e
+
+
+def test_select_index_aging_promotes_one_class_per_period():
+    be = _req("best_effort", submitted_at=0.0)
+    inter = _req("interactive", submitted_at=3.9)
+    q = [be, inter]
+    # waited 2 aging periods: best_effort reaches rank 0 and wins on
+    # queue order against the younger interactive
+    assert select_index(q, now=4.0, aging_s=2.0) == 0
+    # only one period waited: still behind interactive
+    assert select_index(q, now=2.5, aging_s=2.0) == 1
+    # aging disabled: interactive always wins
+    assert select_index(q, now=1e9, aging_s=0.0) == 1
+
+
+def test_no_starvation_for_deadline_less_behind_edf_stream():
+    """Aging is unbounded below zero, so EDF within a class cannot
+    starve a deadline-less sibling: an aged request eventually
+    outranks every deadline-carrying newcomer outright."""
+    aging_s = 1.0
+    plain = _req("interactive", submitted_at=0.0)
+    queue = [plain]
+    now, served_at = 0.0, None
+    for step in range(100):
+        # fresh deadline-carrying interactive arrivals, forever —
+        # each would beat `plain` under pure EDF
+        queue.append(_req("interactive", submitted_at=now,
+                          deadline_t=now + 0.5))
+        i = select_index(queue, now, aging_s=aging_s)
+        if queue[i] is plain:
+            served_at = now
+            break
+        del queue[i]
+        now += 0.1
+    assert served_at is not None, "deadline-less request starved"
+    assert served_at <= 2 * aging_s
+
+
+def test_no_starvation_under_sustained_interactive_stream():
+    """The satellite bound, deterministic with an injected clock and
+    no engine: a best_effort request queued at t=0 behind an endless
+    interactive arrival stream MUST be selected within rank *
+    aging_s (here 2 classes * 1s) — aging makes starvation
+    impossible by construction."""
+    aging_s = 1.0
+    be = _req("best_effort", submitted_at=0.0)
+    queue = [be]
+    now = 0.0
+    served_be_at = None
+    for step in range(100):
+        # one fresh interactive arrival every 100 ms, forever
+        queue.append(_req("interactive", submitted_at=now))
+        i = select_index(queue, now, aging_s=aging_s)
+        if queue[i] is be:
+            served_be_at = now
+            break
+        del queue[i]
+        now += 0.1
+    assert served_be_at is not None, "best_effort starved"
+    assert served_be_at <= len(PRIORITIES) * aging_s
+
+
+# ---------------------------------------------------------------------------
+# the pressure ladder
+# ---------------------------------------------------------------------------
+
+def test_pressure_ladder_levels_and_hysteresis():
+    assert compute_pressure_level(0, 0.0) == 0
+    assert compute_pressure_level(0, 0.49) == 0
+    assert compute_pressure_level(0, 0.50) == 1
+    assert compute_pressure_level(0, 0.75) == 2
+    assert compute_pressure_level(0, 0.95) == 3
+    # exit needs the score to fall BELOW enter - hysteresis: a score
+    # oscillating on the boundary cannot flap the state
+    assert compute_pressure_level(2, 0.70) == 2
+    assert compute_pressure_level(2, 0.64) == 1
+    assert compute_pressure_level(3, 0.82) == 3
+    assert compute_pressure_level(3, 0.30) == 0
+    assert len(PRESSURE_STATES) == 4
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: engine-level parity + compositions
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_byte_parity_and_knob_noop(chunk_dir):
+    """Greedy bytes byte-identical chunking on vs off over mixed
+    prompt lengths, and the 0-knob is a bitwise no-op: identical
+    dispatch counters (no chunk program ever dispatches)."""
+    d, vocab = chunk_dir
+    prompts = _prompts(vocab, 6, seed=1)
+    off, s_off = _run_engine(d, prompts, chunk=0)
+    on, s_on = _run_engine(d, prompts, chunk=BLOCK)
+    assert on == off
+    assert s_off["prefill_chunks"] == 0
+    assert s_off["prefills"] == len(prompts)
+    assert s_on["prefills"] == 0
+    want = sum(-(-int(p.size) // BLOCK) for p in prompts)
+    assert s_on["prefill_chunks"] == want
+    # identical tokens out; decode DISPATCH counts may differ (the
+    # whole point: neighbors keep stepping while a prompt chunks, so
+    # sharing patterns shift) — per-request bytes cannot
+    assert s_on["tokens_out"] == s_off["tokens_out"]
+
+
+def test_chunked_prefill_budget_below_exported_width(chunk_dir):
+    """A smaller block-multiple budget than the exported chunk width
+    dispatches MORE, smaller chunks — bytes unchanged."""
+    d, vocab = chunk_dir
+    # export width is BLOCK, so equal here; assert the validation
+    # rejects a non-multiple and an over-wide budget loudly instead
+    with pytest.raises(ValueError, match="multiple of block_size"):
+        GenerationEngine(load_stepwise(d),
+                         prefill_chunk_tokens=BLOCK + 1)
+    with pytest.raises(ValueError, match="exceeds this artifact"):
+        GenerationEngine(load_stepwise(d),
+                         prefill_chunk_tokens=4 * BLOCK)
+
+
+def test_chunked_prefill_composes_with_prefix_cache(chunk_dir):
+    """A chunk-prefilled cold prompt enters the prefix cache; the
+    identical repeat mounts it with ZERO additional chunk dispatches
+    and byte-identical output; a divergent-suffix prompt reuses the
+    cached leading blocks."""
+    d, vocab = chunk_dir
+    rs = np.random.RandomState(7)
+    base = rs.randint(0, vocab, (PROMPT_LEN,)).astype(np.int32)
+    eng = GenerationEngine(load_stepwise(d),
+                           prefill_chunk_tokens=BLOCK).start()
+    try:
+        a = eng.submit(base, max_new=6).result(timeout=120)
+        chunks0 = eng.stats()["prefill_chunks"]
+        b = eng.submit(base, max_new=6).result(timeout=120)
+        st = eng.stats()
+        assert b == a
+        assert st["prefill_chunks"] == chunks0
+        assert st["prefix_cache_hits"] == 1
+        assert st["prefill_tokens_saved"] > 0
+    finally:
+        eng.close()
+    # the chunk-written block BYTES equal the monolithic prefill's:
+    # an engine WITHOUT chunking must produce the same continuation
+    # from its own cold prefill of the same prompt
+    ref, _ = _run_engine(d, [base], chunk=0)
+    assert a == ref[0]
+
+
+def test_chunked_prefill_composes_with_speculation(tmp_path):
+    """spec_tokens > 0 + chunked prefill: byte parity chunking on vs
+    off on the repetitive workload, with drafts genuinely accepted."""
+    d = str(tmp_path / "spec_chunk")
+    vocab = serving_load.build_export(
+        d, prompt_len=PROMPT_LEN, max_new=12, slots=SLOTS, seed=0,
+        paged=True, block_size=BLOCK, prefill_chunk=BLOCK,
+        spec_tokens=4)
+    rs = np.random.RandomState(3)
+    pattern = rs.randint(0, vocab, (3,)).astype(np.int32)
+    prompts = [np.tile(pattern, 4)[:n].astype(np.int32)
+               for n in (12, 7, 9)]
+    off, s_off = _run_engine(d, prompts, max_new=12, chunk=0,
+                             spec_tokens=4)
+    on, s_on = _run_engine(d, prompts, max_new=12, chunk=BLOCK,
+                           spec_tokens=4)
+    assert on == off
+    assert s_on["prefill_chunks"] > 0
+    assert s_on["spec_accepted"] > 0
+    assert s_on["spec_accepted"] == s_off["spec_accepted"]
+
+
+def test_chunked_prefill_composes_with_weight_int8(tmp_path):
+    """weight_quant='int8' bakes int8 into the DECODE programs only —
+    prefill (and the chunk program) stays full precision, so chunking
+    on vs off stays byte-identical even on the quantized export."""
+    d = str(tmp_path / "w8_chunk")
+    vocab = serving_load.build_export(
+        d, prompt_len=PROMPT_LEN, max_new=MAX_NEW, slots=SLOTS,
+        seed=0, paged=True, block_size=BLOCK, prefill_chunk=BLOCK,
+        weight_quant="int8")
+    prompts = _prompts(vocab, 4, seed=5)
+    off, _ = _run_engine(d, prompts, chunk=0)
+    on, s_on = _run_engine(d, prompts, chunk=BLOCK)
+    assert on == off
+    assert s_on["prefill_chunks"] > 0
+
+
+def test_chunked_prefill_kv_int8_rides_drift_gate(tmp_path):
+    """The kv-int8 composition: a chunk re-reads PRIOR chunks through
+    the quantize/dequant pair the monolithic prefill never pays, so
+    byte identity is not the contract — the repo's documented
+    token-agreement drift bound is (DESIGN.md §15)."""
+    d = str(tmp_path / "kv8_chunk")
+    vocab = serving_load.build_export(
+        d, prompt_len=PROMPT_LEN, max_new=MAX_NEW, slots=SLOTS,
+        seed=0, paged=True, block_size=BLOCK, prefill_chunk=BLOCK,
+        weight_quant="int8", kv_cache_dtype="int8")
+    prompts = _prompts(vocab, 4, seed=9)
+    off, _ = _run_engine(d, prompts, chunk=0)
+    on, s_on = _run_engine(d, prompts, chunk=BLOCK)
+    assert s_on["prefill_chunks"] > 0
+    agreement = serving_load.token_agreement([on], [off])
+    assert agreement >= serving_load.INT8_MIN_AGREEMENT
+
+
+def test_chunked_prefill_respects_deadline_and_cancel(chunk_dir):
+    """A mid-chunked-prefill slot is cancellable and deadline-bound
+    like any live slot: its blocks return and neighbors keep going."""
+    from distributed_tensorflow_example_tpu.serving_batch import \
+        RequestCancelledError
+    d, vocab = chunk_dir
+    rs = np.random.RandomState(11)
+    long_p = rs.randint(0, vocab, (PROMPT_LEN,)).astype(np.int32)
+    eng = GenerationEngine(load_stepwise(d), prefix_cache=False,
+                           prefill_chunk_tokens=BLOCK).start()
+    try:
+        free0 = eng.stats()["blocks_free"]
+        h = eng.submit(long_p, max_new=MAX_NEW)
+        h.cancel()
+        with pytest.raises(RequestCancelledError):
+            h.result(timeout=120)
+        t0 = time.monotonic()
+        while eng.stats()["blocks_free"] != free0 \
+                and time.monotonic() - t0 < 30:
+            time.sleep(0.005)
+        assert eng.stats()["blocks_free"] == free0
+        # the engine still serves to parity afterwards
+        out = eng.submit(long_p, max_new=4).result(timeout=120)
+        ref, _ = _run_engine(d, [long_p], max_new=4, chunk=0)
+        assert out == ref[0]
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# shedding: ladder by class, feasibility, healthz fields
+# ---------------------------------------------------------------------------
+
+def _wait(pred, timeout=30.0, what="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.002)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_brownout_sheds_batch_and_best_effort_not_interactive(
+        chunk_dir):
+    """The admission-time enforcement point, rung by rung — driven on
+    an UNSTARTED engine with the ladder position pinned directly, so
+    no scheduler-drain race can move the rung mid-assertion (the
+    started-engine integration of the same ladder is the tier-1
+    overload_storm chaos scenario)."""
+    d, vocab = chunk_dir
+    prompts = _prompts(vocab, 4, seed=13)
+    eng = GenerationEngine(load_stepwise(d), max_queue=16)
+    try:
+        eng._pressure_level = 1          # shed_best_effort
+        with pytest.raises(ShedError) as ei:
+            eng.submit(prompts[0], max_new=2, priority="best_effort")
+        assert ei.value.retry_after >= 0.0
+        assert "pressure" in str(ei.value)
+        eng.submit(prompts[0], max_new=2, priority="batch")
+        eng._pressure_level = 2          # shed_batch
+        with pytest.raises(ShedError):
+            eng.submit(prompts[1], max_new=2, priority="batch")
+        with pytest.raises(ShedError):
+            eng.submit(prompts[1], max_new=2,
+                       priority="best_effort")
+        eng.submit(prompts[1], max_new=2)        # interactive admits
+        eng._pressure_level = 3          # interactive_only
+        with pytest.raises(ShedError):
+            eng.submit(prompts[2], max_new=2, priority="batch")
+        eng.submit(prompts[2], max_new=2)        # still admits
+        st = eng.stats()
+        assert st["shed_batch"] == 2
+        assert st["shed_best_effort"] == 2
+        assert st["shed_interactive"] == 0
+        assert st["shed"] == 4
+    finally:
+        eng.close()
+
+
+def test_brownout_level3_sheds_queued_non_interactive(chunk_dir):
+    """interactive_only additionally sweeps QUEUED batch/best_effort
+    requests: pre-loaded on an unstarted engine with the ladder
+    pinned high via a wedged score (tiny max_queue), the scheduler's
+    first pressure tick must shed them 429-class while the
+    interactive backlog is served to completion."""
+    d, vocab = chunk_dir
+    prompts = _prompts(vocab, 6, seed=31)
+    eng = GenerationEngine(load_stepwise(d), max_queue=4)
+    try:
+        # pre-load: 3 interactive + 1 batch — depth 4/4 = score 1.0,
+        # so the FIRST scheduler tick enters interactive_only and
+        # sweeps the queued batch request before any admission
+        inter = [eng.submit(p, max_new=2) for p in prompts[:3]]
+        victim = eng.submit(prompts[3], max_new=2, priority="batch")
+        eng.start()
+        with pytest.raises(ShedError):
+            victim.result(timeout=120)
+        outs = [h.result(timeout=120) for h in inter]
+        assert all(outs)
+        st = eng.stats()
+        assert st["shed_batch"] == 1
+        assert st["shed_interactive"] == 0
+        _wait(lambda: eng.stats()["pressure"] == "healthy",
+              what="recovery to healthy")
+        assert eng.stats()["pressure_transitions"] >= 2
+    finally:
+        eng.close()
+
+
+def test_shed_policy_off_disables_ladder_and_feasibility(chunk_dir):
+    d, vocab = chunk_dir
+    prompts = _prompts(vocab, 8, seed=17)
+    eng = GenerationEngine(load_stepwise(d), max_queue=16,
+                           shed_policy="off").start()
+    try:
+        handles = [eng.submit(p, max_new=MAX_NEW) for p in prompts]
+        # deep backlog, but the ladder is off: best_effort admits fine
+        h = eng.submit(prompts[0], max_new=2, priority="best_effort")
+        assert h.result(timeout=120)
+        [x.result(timeout=120) for x in handles]
+        st = eng.stats()
+        assert st["shed"] == 0
+        assert st["pressure"] == "healthy"
+        assert st["pressure_transitions"] == 0
+    finally:
+        eng.close()
+
+
+def test_infeasible_deadline_shed_immediately_as_429_class(chunk_dir):
+    """A queued request whose deadline cannot be met at the MEASURED
+    rate is shed NOW (ShedError -> HTTP 429 + Retry-After), instead of
+    rotting in the queue and 504ing — and it never takes a slot."""
+    d, vocab = chunk_dir
+    prompts = _prompts(vocab, 3, seed=19)
+    eng = GenerationEngine(load_stepwise(d))
+    # pre-seed the measured rate BEFORE start (the test's injected
+    # "measured" signal: 10 s per decode step makes ANY bounded
+    # deadline infeasible deterministically — no sleeps, no races)
+    eng._retry.observe(10.0)
+    victim = eng.submit(prompts[1], max_new=MAX_NEW,
+                        deadline_ms=5_000)
+    survivor = eng.submit(prompts[2], max_new=2)
+    eng.start()
+    try:
+        with pytest.raises(ShedError) as ei:
+            victim.result(timeout=120)
+        assert "deadline infeasible" in str(ei.value)
+        assert survivor.result(timeout=120)
+        st = eng.stats()
+        assert st["shed_infeasible"] == 1
+        assert st["shed_interactive"] == 1
+        assert st["shed"] == 1
+        # the whole point: a 429-class shed, not a 504 after rotting
+        assert st["deadline_expired"] == 0
+    finally:
+        eng.close()
+
+
+def test_healthz_carries_saturation_fields(chunk_dir):
+    d, vocab = chunk_dir
+    eng = GenerationEngine(load_stepwise(d)).start()
+    try:
+        h = eng.health()
+        assert h["pressure"] == "healthy"
+        assert h["saturated"] is False
+        assert h["queue_age_s"] == 0.0
+        assert h["queue_limit"] == 64
+        # a queued request ages visibly
+        handles = [eng.submit(p, max_new=MAX_NEW)
+                   for p in _prompts(vocab, SLOTS + 3, seed=29)]
+        _wait(lambda: eng.health()["queue_age_s"] > 0.0,
+              what="queue age becoming visible")
+        [x.result(timeout=120) for x in handles]
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# router satellite: saturated replicas demote to degraded
+# ---------------------------------------------------------------------------
+
+class _FakeReplica:
+    """A minimal /healthz endpoint whose saturation answer the test
+    flips — the router probe test's stand-in for an overloaded-but-
+    live engine."""
+
+    def __init__(self):
+        self.saturated = False
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({
+                    "status": "live", "draining": False,
+                    "queue_age_s": 9.9 if fake.saturated else 0.0,
+                    "pressure": ("shed_batch" if fake.saturated
+                                 else "healthy"),
+                    "saturated": fake.saturated,
+                    "mono_now": time.perf_counter()}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_router_demotes_saturated_replica_to_last_resort():
+    """A live-but-saturated replica stops being PREFERRED (a healthy
+    sibling takes its traffic) but remains the last-resort tier — a
+    fleet-wide brownout must reach the replicas' own class ladders,
+    never collapse into a blanket router 503 for the interactive
+    traffic those ladders protect."""
+    fake, healthy = _FakeReplica(), _FakeReplica()
+    router = ReplicaRouter([f"http://127.0.0.1:{fake.port}",
+                            f"http://127.0.0.1:{healthy.port}"],
+                           probe_interval_s=0.02)
+    sat_name = f"127.0.0.1:{fake.port}"
+    try:
+        router.start()
+        _wait(lambda: set(router.replica_states().values())
+              == {"healthy"}, what="both replicas healthy")
+        fake.saturated = True
+        _wait(lambda: router.replica_states()[sat_name]
+              == "saturated", what="saturation demotion")
+        # with a healthy sibling, the saturated replica is never picked
+        for _ in range(5):
+            assert router._pick(set(), None).name != sat_name
+        assert router.fleet_health()["status"] == "live"
+        # the healthy sibling gone: the saturated replica is the last
+        # resort — still routed to, fleet healthz says saturated (503
+        # pushback upstream) rather than unserved
+        _wait(lambda: router.replica_states()[sat_name]
+              == "saturated", what="state settle")
+        picked = router._pick({f"127.0.0.1:{healthy.port}"}, None)
+        assert picked is not None and picked.name == sat_name
+        healthy.saturated = True
+        _wait(lambda: set(router.replica_states().values())
+              == {"saturated"}, what="fleet-wide saturation")
+        assert router._pick(set(), None) is not None
+        assert router.fleet_health()["status"] == "saturated"
+        # recovery: the next unsaturated 200 probe restores healthy
+        fake.saturated = healthy.saturated = False
+        _wait(lambda: set(router.replica_states().values())
+              == {"healthy"}, what="re-admission after recovery")
+        assert router._pick(set(), None) is not None
+    finally:
+        router.close()
+        fake.close()
+        healthy.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: priority knob + chunk knob auto-off
+# ---------------------------------------------------------------------------
+
+def test_http_priority_knob_and_defaults(chunk_dir):
+    import urllib.error
+    import urllib.request
+
+    from distributed_tensorflow_example_tpu.serving_http import \
+        PredictServer
+    d, vocab = chunk_dir
+
+    def post(port, name, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/{name}:generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    with PredictServer(d, default_priority="batch",
+                       prefill_chunk_tokens=BLOCK) as srv:
+        out = post(srv.port, srv.name,
+                   {"inputs": {"input_ids": [[1, 2, 3]]},
+                    "max_new": 3, "priority": "interactive"})
+        assert len(out["generations"][0]) == 3
+        # default class applies when the payload carries none
+        out = post(srv.port, srv.name,
+                   {"inputs": {"input_ids": [[4, 5]]}, "max_new": 2})
+        assert len(out["generations"][0]) == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(srv.port, srv.name,
+                 {"inputs": {"input_ids": [[1]]}, "priority": "vip"})
+        assert ei.value.code == 400
+        assert "priority" in json.loads(ei.value.read())["error"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(srv.port, srv.name,
+                 {"inputs": {"input_ids": [[1]]}, "priority": 3})
+        assert ei.value.code == 400
+        # chunking served this traffic (the knob reached the engine)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/stats") as r:
+            st = json.loads(r.read())["generate"]
+        assert st["prefill_chunk_tokens"] == BLOCK
+        assert st["prefill_chunks"] > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz") as r:
+            h = json.loads(r.read())
+        assert h["pressure"] == "healthy" and h["saturated"] is False
+
+
+def test_http_chunk_knob_auto_off_without_program(tmp_path):
+    """--prefill_chunk_tokens over an artifact without the chunk
+    program serves WITHOUT chunking (logged warning), mirroring the
+    --spec_tokens auto-off contract."""
+    from distributed_tensorflow_example_tpu.serving_http import \
+        PredictServer
+    d = str(tmp_path / "nochunk")
+    serving_load.build_export(d, prompt_len=PROMPT_LEN,
+                              max_new=MAX_NEW, slots=2, seed=0,
+                              paged=True, block_size=BLOCK)
+    with PredictServer(d, prefill_chunk_tokens=BLOCK) as srv:
+        assert srv.engine.prefill_chunk_tokens == 0
