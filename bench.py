@@ -585,6 +585,25 @@ def _run_serving(*, clients: int, requests: int, prompt_len: int,
             1.0 - serving_load.token_agreement(irow["_gens"],
                                                prow["_gens"]), 4),
         "serving_int8_errors": len(irow["errors"]),
+        # round-19 SLO columns: goodput (deadline-met tokens/s —
+        # distinct from raw serving_tps; equal on this deadline-less
+        # matrix, divergent the moment a deadline workload sheds or
+        # expires) and attainment, both sourced from the registry's
+        # serving_slo_*/goodput counters, never client bookkeeping
+        "serving_goodput_tps": round(
+            row["tokens_per_s"]
+            * int(reg.get("serving_goodput_tokens_total", 0))
+            / int(reg["serving_tokens_out_total"]), 2)
+        if int(reg.get("serving_tokens_out_total", 0)) else 0.0,
+        "serving_slo_attainment": round(
+            int(reg.get("serving_slo_good_total", 0))
+            / int(reg["serving_slo_served_total"]), 4)
+        if int(reg.get("serving_slo_served_total", 0)) else 0.0,
+        "serving_slo_attainment_interactive": round(
+            int(reg.get("serving_slo_good_interactive_total", 0))
+            / int(reg["serving_slo_served_interactive_total"]), 4)
+        if int(reg.get("serving_slo_served_interactive_total", 0))
+        else 0.0,
         "serving_bytes_resident_peak": int(
             preg.get("serving_bytes_resident_peak", 0)),
         "serving_int8_bytes_resident_peak": int(

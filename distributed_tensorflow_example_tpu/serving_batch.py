@@ -178,6 +178,23 @@ admission, graceful shedding):
   gap seen by slots that stayed live across it — the p95
   decode-stall-under-long-prompt proof surface).
 
+Round 19 — SLO attainment accounting (DESIGN.md §22): every request
+reaching a TERMINAL outcome (retired, shed, expired, cancelled,
+failed) passes through :meth:`GenerationEngine._account_outcome`
+exactly once (a per-request latch — several failure paths can race
+toward the same request): the per-class + aggregate
+``serving_slo_served_*`` / ``serving_slo_good_*`` counters (good =
+retired normally within the request's own deadline),
+``serving_goodput_tokens_total`` (good retirements' tokens — goodput
+tps, distinct from raw throughput), and per-class
+``serving_latency_<class>_seconds`` histograms at retirement. The
+request-log JSONL event carries the completed schema (``priority``,
+``deadline_ms``, ``outcome``, ``slo_good``) for every outcome — the
+ground truth ``tools/servetop.py`` and the SLO burn evaluation
+(obs/slo.py over the obs/timeseries.py ring) reconcile against.
+Blunt queue-full and draining refusals are NOT served outcomes:
+they precede admission accounting and the client retries them.
+
 Round 10 — block-paged pool + shared-prefix reuse: with a PAGED
 stepwise artifact (``export_generator(..., paged=True)``) the engine
 swaps the ``slots × T`` slab reservation for a shared pool of
@@ -938,6 +955,10 @@ class GenRequest:
     t_admit: float = 0.0            # popped from the queue (slot owned)
     t_first: float = 0.0            # first sampled token emitted
     timings: dict | None = None     # set just before future resolves
+    # terminal-outcome accounting latch (round 19): the SLO served/
+    # good counters and the request-log outcome event fire exactly
+    # once per request no matter which failure path retires it
+    accounted: bool = False
 
     def sampler(self):
         """The per-request host RNG stream: a seeded Philox generator,
@@ -1286,6 +1307,68 @@ class GenerationEngine:
             "serving_request_decode_seconds",
             "first-sample-to-retirement decode time",
             buckets=SERVING_LATENCY_BUCKETS)
+        # ---- SLO attainment observables (round 19): every request
+        # reaching a terminal outcome (retired, shed, expired,
+        # cancelled, failed) counts served for its class EXACTLY ONCE
+        # (_account_outcome); good additionally requires a normal
+        # retirement within the request's own deadline. The per-class
+        # pairs are what obs/slo.py's hit_rate objectives window over;
+        # the aggregate pair keeps the classless fleet ratio cheap.
+        # Blunt queue-full and draining refusals are NOT served: they
+        # precede admission accounting and the client retries them.
+        self._c_slo_served_all = reg.counter(
+            "serving_slo_served_total",
+            "requests reaching any terminal outcome (all classes) — "
+            "the SLO attainment denominator")
+        self._c_slo_good_all = reg.counter(
+            "serving_slo_good_total",
+            "requests retired normally within their deadline (all "
+            "classes) — the SLO attainment numerator")
+        self._c_slo_served = {
+            "interactive": reg.counter(
+                "serving_slo_served_interactive_total",
+                "interactive requests reaching a terminal outcome"),
+            "batch": reg.counter(
+                "serving_slo_served_batch_total",
+                "batch requests reaching a terminal outcome"),
+            "best_effort": reg.counter(
+                "serving_slo_served_best_effort_total",
+                "best_effort requests reaching a terminal outcome"),
+        }
+        self._c_slo_good = {
+            "interactive": reg.counter(
+                "serving_slo_good_interactive_total",
+                "interactive requests retired within deadline"),
+            "batch": reg.counter(
+                "serving_slo_good_batch_total",
+                "batch requests retired within deadline"),
+            "best_effort": reg.counter(
+                "serving_slo_good_best_effort_total",
+                "best_effort requests retired within deadline"),
+        }
+        self._c_goodput_tokens = reg.counter(
+            "serving_goodput_tokens_total",
+            "tokens emitted by good requests (retired within "
+            "deadline) — goodput tps, distinct from raw "
+            "serving_tokens_out_total throughput")
+        # per-class latency histograms: the p95_ms objectives need the
+        # interactive tail separable from batch/best_effort bulk —
+        # the global serving_request_latency_seconds cannot give a
+        # per-class quantile
+        self._h_class_latency = {
+            "interactive": reg.histogram(
+                "serving_latency_interactive_seconds",
+                "submit-to-retirement latency of interactive requests",
+                buckets=SERVING_LATENCY_BUCKETS),
+            "batch": reg.histogram(
+                "serving_latency_batch_seconds",
+                "submit-to-retirement latency of batch requests",
+                buckets=SERVING_LATENCY_BUCKETS),
+            "best_effort": reg.histogram(
+                "serving_latency_best_effort_seconds",
+                "submit-to-retirement latency of best_effort requests",
+                buckets=SERVING_LATENCY_BUCKETS),
+        }
         self._latencies: deque[float] = deque(maxlen=2048)
         # slot-lane bookkeeping: when slot i last freed, so a reused
         # slot's queue-wait span is clamped to its own tenancy (the
@@ -1660,6 +1743,7 @@ class GenerationEngine:
                         for r in victims:
                             self._c_shed.inc()
                             self._c_shed_class[r.priority].inc()
+                            self._account_outcome(r, "shed")
                     raise ShedError(
                         f"shedding {victims[0].priority} requests "
                         f"under load (pressure "
@@ -1756,6 +1840,7 @@ class GenerationEngine:
             else:
                 return False
         self._c_cancelled.inc()
+        self._account_outcome(victim, "cancelled")
         victim.future.set_exception(RequestCancelledError(
             f"request {request_id} cancelled while queued"))
         return True
@@ -1938,14 +2023,17 @@ class GenerationEngine:
                                         + len(self._live)  # graftlint: disable=THR01
                                         + len(self._prefilling))  # graftlint: disable=THR01
             for req in self._queue:
+                self._account_outcome(req, "failed")
                 req.future.set_exception(err)
             self._queue.clear()
             self._g_queue_depth.set(0)
             for slot in self._live.values():  # graftlint: disable=THR01
+                self._account_outcome(slot.req, "failed")
                 slot.req.future.set_exception(err)
             self._live.clear()  # graftlint: disable=THR01
             self._g_live_slots.set(0)
             for slot in self._prefilling.values():  # graftlint: disable=THR01
+                self._account_outcome(slot.req, "failed")
                 slot.req.future.set_exception(err)
             self._prefilling.clear()  # graftlint: disable=THR01
             self._g_prefilling_slots.set(0)
@@ -2008,14 +2096,17 @@ class GenerationEngine:
                                + len(self._prefilling)})
                 with self._cond:
                     if self._admitting is not None:
+                        self._account_outcome(self._admitting, "failed")
                         self._admitting.future.set_exception(err)
                         self._admitting = None
                         self._c_requests_failed.inc()
                     self._c_requests_failed.inc(len(self._live)
                                                 + len(self._prefilling))
                     for slot in self._live.values():
+                        self._account_outcome(slot.req, "failed")
                         slot.req.future.set_exception(err)
                     for slot in self._prefilling.values():
+                        self._account_outcome(slot.req, "failed")
                         slot.req.future.set_exception(err)
                     self._live.clear()
                     self._prefilling.clear()
@@ -2074,6 +2165,7 @@ class GenerationEngine:
                 self._g_queue_depth.set(len(self._queue))
         for r in requeued:
             self._c_cancelled.inc()
+            self._account_outcome(r, "cancelled")
             r.future.set_exception(RequestCancelledError(
                 f"request {r.request_id} cancelled while re-queued "
                 "under block pressure"))
@@ -2100,6 +2192,7 @@ class GenerationEngine:
                 self._g_queue_depth.set(len(self._queue))
         for r in expired:
             self._c_deadline.inc()
+            self._account_outcome(r, "expired")
             r.future.set_exception(DeadlineExceededError(
                 f"request {r.request_id} missed its {r.deadline_ms} ms "
                 "deadline while queued (never admitted)"))
@@ -2206,6 +2299,7 @@ class GenerationEngine:
             self._free.append(index)
             self._inflight_ids.discard(req.request_id)
         self._slot_freed_t[index] = time.perf_counter()
+        self._account_outcome(req, "failed")
         req.future.set_exception(
             err if isinstance(err, BlocksExhaustedError)
             else PoisonedRequestError(
@@ -2589,6 +2683,7 @@ class GenerationEngine:
                 self._c_shed_class[r.priority].inc()
                 if infeasible_counter:
                     self._c_shed_infeasible.inc()
+                self._account_outcome(r, "shed")
         for r in victims:
             r.future.set_exception(ShedError(
                 f"request {r.request_id} shed while queued "
@@ -2631,6 +2726,13 @@ class GenerationEngine:
                 self._last_dispatch_t = 0.0
         (counter if counter is not None
          else self._c_requests_failed).inc()
+        self._account_outcome(
+            slot.req,
+            "expired" if isinstance(err, DeadlineExceededError)
+            else "cancelled" if isinstance(err, RequestCancelledError)
+            else "shed" if isinstance(err, ShedError)
+            else "failed",
+            tokens=len(slot.tokens))
         with self._cond:
             self._free.append(slot.index)
             self._g_live_slots.set(len(self._live))
@@ -2748,6 +2850,40 @@ class GenerationEngine:
         else:
             self._live[slot.index] = slot
 
+    def _account_outcome(self, req: GenRequest, outcome: str, *,
+                         good: bool = False, tokens: int = 0) -> None:
+        """Per-request terminal accounting, EXACTLY ONCE per request
+        (the ``req.accounted`` latch — several failure paths can race
+        toward the same request): the per-class + aggregate SLO
+        served/good counters, goodput tokens, and — for non-``ok``
+        outcomes — the request-log event (the ``ok`` event is emitted
+        by :meth:`_retire` with the full timings breakdown, AFTER the
+        future resolves). Callable from any thread: touches only the
+        request, the registry, and the JSONL sink."""
+        if req.accounted:
+            return
+        req.accounted = True
+        with self.registry.atomic():
+            self._c_slo_served_all.inc()
+            self._c_slo_served[req.priority].inc()
+            if good:
+                self._c_slo_good_all.inc()
+                self._c_slo_good[req.priority].inc()
+                if tokens:
+                    self._c_goodput_tokens.inc(tokens)
+        if outcome != "ok" and self.metrics_logger is not None:
+            self.metrics_logger.log({
+                "event": "generate",
+                "request_id": req.request_id,
+                "outcome": outcome,
+                "priority": req.priority,
+                "deadline_ms": req.deadline_ms,
+                "slo_good": False,
+                "tokens": int(tokens),
+                "total_ms": round((time.perf_counter()
+                                   - req.submitted_at) * 1e3, 3),
+            })
+
     @scheduler_thread
     def _retire(self, slot: _Slot, toks: list[int]) -> None:
         """Retirement: timings breakdown, spans, counters, slot free,
@@ -2767,6 +2903,12 @@ class GenerationEngine:
                      process=self.process, lane=lane,
                      request_id=req.request_id,
                      tokens=len(slot.tokens), **req.trace)
+        # good = retired normally AND inside its own deadline (no
+        # deadline = always good): THE definition the SLO counters,
+        # the goodput tps, and the request-log replay all share —
+        # recorded explicitly (slo_good) so offline consumers never
+        # re-derive it from rounded millisecond fields
+        good = not req.deadline_t or t_ret <= req.deadline_t
         req.timings = {
             "request_id": req.request_id,
             "queue_ms": round((req.t_admit - req.submitted_at) * 1e3, 3),
@@ -2781,6 +2923,14 @@ class GenerationEngine:
             # request (0 with speculation off) — the per-request view
             # of serving_spec_accepted_total
             "spec_accepted": slot.spec_accepted,
+            # request-log completeness (round 19): the JSONL event is
+            # the ground truth servetop and the SLO counters reconcile
+            # against, so it must carry the class, the budget, and the
+            # outcome — not just the phase timings
+            "priority": req.priority,
+            "deadline_ms": req.deadline_ms,
+            "outcome": "ok",
+            "slo_good": good,
         }
         with span("retire", process=self.process, lane=lane,
                   request_id=req.request_id, **req.trace):
@@ -2800,10 +2950,14 @@ class GenerationEngine:
         with self.registry.atomic():
             self._c_requests_done.inc()
             self._h_latency.observe(t_ret - req.submitted_at)
+            self._h_class_latency[req.priority].observe(
+                t_ret - req.submitted_at)
             self._h_queue_wait.observe(req.t_admit - req.submitted_at)
             self._h_prefill.observe(slot.t_prefill_done - req.t_admit)
             self._h_decode.observe(t_ret - max(slot.t_prefill_done,
                                                req.t_first or 0.0))
+            self._account_outcome(req, "ok", good=good,
+                                  tokens=len(slot.tokens))
         self._latencies.append(t_ret - req.submitted_at)
         req.future.set_result(toks)
         if self.metrics_logger is not None:
@@ -3216,6 +3370,13 @@ class GenerationEngine:
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "prefill_chunks": c("serving_prefill_chunks_total"),
             "prefilling_slots": c("serving_prefilling_slots"),
+            # SLO attainment observables (round 19): the aggregate
+            # served/good pair and goodput tokens at a glance — the
+            # per-class pairs and windowed rates live on /metrics and
+            # GET /stats/history
+            "slo_served": c("serving_slo_served_total"),
+            "slo_good": c("serving_slo_good_total"),
+            "goodput_tokens": c("serving_goodput_tokens_total"),
             "latency_p50_ms": round(percentile(lat, 50) * 1e3, 2),
             "latency_p95_ms": round(percentile(lat, 95) * 1e3, 2),
             "latency_p99_ms": round(percentile(lat, 99) * 1e3, 2),

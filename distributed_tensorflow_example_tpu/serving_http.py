@@ -130,6 +130,21 @@ starts mass-shedding; ``/stats`` and ``/metrics`` carry the
 ``serving_shed_*`` / pressure / chunk counters and the
 ``serving_decode_stall_seconds`` histogram.
 
+SLO attainment & goodput observability (round 19, DESIGN.md §22):
+``--history_interval_s S`` arms a :class:`~.obs.timeseries.
+SnapshotSampler` — the atomic registry snapshot captured into a
+bounded ring every S seconds, served as ``GET /stats/history`` (a
+poll also captures a fresh sample, so the endpoint is always
+current) — and evaluates ``--slo_spec`` objectives
+(:mod:`~.obs.slo`) over it on every capture: per-class attainment +
+fast/slow burn rates ride ``/stats/history``, an ADVISORY ``slo``
+block rides ``/healthz`` (never the status code), and a multi-window
+burn breach writes a rate-limited ``slo_burn`` incident bundle
+(objectives, burn rates, history tail, registry snapshot) through
+the flight recorder. Off (the default) is a provable no-op: no
+sampler exists and no request-path code looks for one —
+``tools/servetop.py`` renders the endpoint live or from a dump.
+
 Fleet (round 15): N of these servers sit behind
 :class:`~.serving_router.ReplicaRouter` — ``/healthz`` (live/stalled/
 draining) drives the router's replica state machine, ``POST
@@ -164,6 +179,8 @@ from typing import Any
 import numpy as np
 
 from .obs import prom as obs_prom
+from .obs import slo as obs_slo
+from .obs import timeseries as obs_ts
 from .obs import trace as obs_trace
 from .obs.flightrec import FlightRecorder
 from .obs.registry import Registry
@@ -211,7 +228,14 @@ class PredictServer:
                  priority_aging_ms: int = 2000,
                  process_name: str | None = None,
                  flight_recorder: bool = True,
-                 incident_dir: str | None = None):
+                 incident_dir: str | None = None,
+                 history_interval_s: float = 0.0,
+                 history_samples: int = 600,
+                 slo_spec: str | None = None,
+                 slo_fast_window_s: float = obs_slo.FAST_WINDOW_S,
+                 slo_slow_window_s: float = obs_slo.SLOW_WINDOW_S,
+                 slo_burn_threshold: float = obs_slo.BURN_THRESHOLD,
+                 history_clock=None):
         if scheduler not in ("auto", "on", "off"):
             raise ValueError(f"scheduler must be auto/on/off, got "
                              f"{scheduler!r}")
@@ -283,6 +307,57 @@ class PredictServer:
                 request_log_path=request_log,
                 counter=self._c_incidents,
                 suppressed_counter=self._c_incidents_suppressed)
+        # ---- SLO observability (round 19): metric time-series +
+        # burn-rate evaluation. OFF by default (--history_interval_s
+        # 0): no sampler object exists and NO request-path code ever
+        # consults one — the sampler is a pure registry READER on its
+        # own thread, so arming it is byte- and dispatch-identical
+        # serving (the armed-vs-plain contract the smoke slo_on leg
+        # pins). GET /stats/history also captures a fresh sample, so
+        # a poll always sees the current instant and tests drive the
+        # ring without sleeping.
+        if history_interval_s < 0:
+            raise ValueError(f"history_interval_s must be >= 0 (0 = "
+                             f"sampler off), got {history_interval_s}")
+        if slo_spec and not history_interval_s:
+            raise ValueError(
+                "--slo_spec declares objectives but --history_interval_s "
+                "is 0 — burn rates are windowed over the history ring; "
+                "arm the sampler to evaluate them")
+        self.slo_fast_window_s = float(slo_fast_window_s)
+        self.slo_slow_window_s = float(slo_slow_window_s)
+        self.slo_burn_threshold = float(slo_burn_threshold)
+        self._slo_objectives: list[obs_slo.Objective] = []
+        self._slo_lock = threading.Lock()
+        self._slo_results: list[dict] | None = None
+        self._sampler = None
+        if history_interval_s:
+            self._slo_objectives = (obs_slo.parse_slo_spec(slo_spec)
+                                    if slo_spec
+                                    else obs_slo.default_objectives())
+            # a p95_ms target beyond the latency histograms' finite
+            # bucket coverage is unmeasurable: requests landing in the
+            # +Inf bucket cannot be classified against it, and the
+            # pessimistic count would page spurious breaches forever —
+            # refuse the misconfiguration loudly at arm time
+            from .obs.registry import SERVING_LATENCY_BUCKETS
+            top_ms = max(SERVING_LATENCY_BUCKETS) * 1e3
+            for o in self._slo_objectives:
+                if o.kind == "p95_ms" and o.target > top_ms:
+                    raise ValueError(
+                        f"slo_spec objective {o.key()}: target "
+                        f"{o.target:g} ms exceeds the latency "
+                        f"histograms' largest finite bucket "
+                        f"({top_ms:g} ms) — observations beyond it "
+                        "are indistinguishable, so this objective "
+                        "cannot be evaluated; lower the target or "
+                        "widen SERVING_LATENCY_BUCKETS")
+            kw = {"clock": history_clock} if history_clock else {}
+            self._sampler = obs_ts.SnapshotSampler(
+                self._metrics_snapshot,
+                interval_s=history_interval_s,
+                max_samples=history_samples,
+                on_sample=self._on_history_sample, **kw)
         # the single-flight lock for the direct path: _execute is called
         # from ThreadingHTTPServer handler threads, and nothing else
         # serializes the executable (the scheduler paths serialize by
@@ -838,6 +913,12 @@ class PredictServer:
                 elif self.path in ("/stats",
                                    f"/v1/models/{server.name}/stats"):
                     self._send(200, server.stats())
+                elif self.path in ("/stats/history",
+                                   f"/v1/models/{server.name}"
+                                   "/stats/history"):
+                    # the metric time-series ring (+ a fresh sample)
+                    # for servetop and the router's fleet rollup
+                    self._send(200, server.stats_history())
                 elif self.path in ("/metrics",
                                    f"/v1/models/{server.name}/metrics"):
                     self._send_text(200, server.metrics_text(),
@@ -939,12 +1020,19 @@ class PredictServer:
     # -- lifecycle ------------------------------------------------------
     def serve(self) -> None:
         """Blocking serve loop (the CLI path); Ctrl-C stops cleanly."""
+        if self._sampler is not None:
+            self._sampler.start()
         try:
             self._httpd.serve_forever()
         except KeyboardInterrupt:
             self.stop()
 
     def start(self) -> "PredictServer":
+        if self._sampler is not None:
+            # first capture lands immediately: a just-started server
+            # already holds its zero baseline, so the first window
+            # delta covers the server's whole life
+            self._sampler.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="predict-server",
                                         daemon=True)
@@ -964,6 +1052,87 @@ class PredictServer:
     def metrics_text(self) -> str:
         """``GET /metrics``: Prometheus text exposition."""
         return obs_prom.render(self._metrics_snapshot())
+
+    def _on_history_sample(self, sampler) -> None:
+        """Runs after every CADENCE ring capture; ``GET
+        /stats/history`` polls evaluate separately over ring + their
+        ephemeral freshness sample."""
+        self._evaluate_slo(sampler.history())
+
+    def _evaluate_slo(self, history) -> list[dict] | None:
+        """Evaluate the objectives over ``history``, publish the
+        results for ``/healthz``/``/stats/history``, and turn a
+        multi-window burn breach into a rate-limited ``slo_burn``
+        incident bundle carrying the offending objectives and the
+        history tail. Never raises into a caller (the sampler already
+        guards, but a burn evaluator that could kill sampling would
+        blind exactly the incident it exists to evidence)."""
+        try:
+            results = obs_slo.evaluate(
+                history, self._slo_objectives,
+                fast_s=self.slo_fast_window_s,
+                slow_s=self.slo_slow_window_s,
+                threshold=self.slo_burn_threshold)
+        except Exception as e:          # noqa: BLE001 — see docstring
+            from .utils.logging import get_logger
+            get_logger("serving").warning("slo evaluation failed: %s",
+                                          e)
+            return None
+        with self._slo_lock:
+            self._slo_results = results
+        breaching = [r for r in results if r["breach"]]
+        if breaching and self._flightrec is not None:
+            worst = max(breaching, key=lambda r: r["burn_fast"])
+            tail = list(history)[-8:]
+            self._flightrec.incident(
+                "slo_burn",
+                detail=(f"{worst['class']}:{worst['kind']} burning "
+                        f"{worst['burn_fast']}x fast / "
+                        f"{worst['burn_slow']}x slow (goal "
+                        f"{worst['goal']}, attainment "
+                        f"{worst['attainment']})"),
+                extra={"slo": results,
+                       "slo_windows": {
+                           "fast_s": self.slo_fast_window_s,
+                           "slow_s": self.slo_slow_window_s,
+                           "threshold": self.slo_burn_threshold},
+                       "history_tail": [[t, snap] for t, snap in tail]})
+        return results
+
+    def stats_history(self) -> dict:
+        """``GET /stats/history``: the time-series ring as JSON —
+        ``[t, snapshot]`` samples (t in this process's perf_counter
+        clock; ``clock`` rides beside them so the router's rollup can
+        align), the declared objectives, and the latest burn-rate
+        results. The poll appends an EPHEMERAL fresh sample (and
+        evaluates the objectives over ring + it, so breach checks are
+        always current), but the ring itself stores only cadence
+        samples — concurrent pollers can never erode its time
+        coverage below the burn windows it was sized for. Sampler
+        off: ``{"enabled": false}`` with empty samples — a 200, so
+        fleet scrapes degrade gracefully."""
+        if self._sampler is None:
+            return {"enabled": False, "process": self.process_name,
+                    "clock": time.perf_counter(), "samples": [],
+                    "slo": None}
+        history = self._sampler.history() + [self._sampler.peek()]
+        results = self._evaluate_slo(history)
+        if results is None:
+            with self._slo_lock:
+                results = self._slo_results
+        return obs_ts.to_payload(
+            history,
+            enabled=True,
+            process=self.process_name,
+            clock=time.perf_counter(),
+            interval_s=self._sampler.interval_s,
+            max_samples=self._sampler.max_samples,
+            slo={"objectives": [o.to_dict()
+                                for o in self._slo_objectives],
+                 "results": results,
+                 "fast_window_s": self.slo_fast_window_s,
+                 "slow_window_s": self.slo_slow_window_s,
+                 "burn_threshold": self.slo_burn_threshold})
 
     def trace_start(self) -> dict:
         """``POST /trace/start``: arm the span recorder (clears any
@@ -1019,6 +1188,14 @@ class PredictServer:
         else:
             h = {"status": "live", "scheduler": self.scheduler}
         h["mono_now"] = time.perf_counter()
+        if self._sampler is not None:
+            # ADVISORY only — burn is an operator page, not a
+            # load-balancer signal, so it never changes the status
+            # code (a breaching-but-live replica still takes traffic)
+            with self._slo_lock:
+                results = self._slo_results
+            if results is not None:
+                h["slo"] = obs_slo.summarize(results)
         return h
 
     def cancel(self, request_id: str) -> bool:
@@ -1054,6 +1231,8 @@ class PredictServer:
         is fail-fast: listener down first, queued/live requests failed
         loudly. Both raise :class:`~.serving_batch.EngineStalledError`
         when the scheduler thread never parks."""
+        if self._sampler is not None:
+            self._sampler.stop()
         try:
             if self.engine is not None and drain:
                 self.engine.drain()
@@ -1082,6 +1261,8 @@ class PredictServer:
         crash takes the wedged thread with it, so raising
         ``EngineStalledError`` here would make the simulated crash
         LESS abrupt than the real one."""
+        if self._sampler is not None:
+            self._sampler.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
@@ -1205,6 +1386,27 @@ def main(argv=None) -> int:
                     "without POST /trace/start so failures have "
                     "history; off: byte- and dispatch-identical "
                     "serving with the ring armed on demand only)")
+    ap.add_argument("--history_interval_s", type=float, default=0.0,
+                    help="metric time-series: capture the registry "
+                    "snapshot into a bounded ring every this many "
+                    "seconds, served by GET /stats/history (rates, "
+                    "window quantiles, SLO burn — the servetop feed); "
+                    "0 = off, a provable no-op (the sampler is a pure "
+                    "registry reader on its own thread)")
+    ap.add_argument("--history_samples", type=int, default=600,
+                    help="history ring bound (oldest samples drop "
+                    "first); size it to cover the slow burn window: "
+                    "samples >= slow_window_s / history_interval_s")
+    ap.add_argument("--slo_spec", default=None,
+                    help="per-class objectives, 'class:kind=target"
+                    "[@goal]' joined with ';' — kinds: hit_rate "
+                    "(deadline hit rate; =X is the goal), p95_ms "
+                    "(latency bound in ms, @goal default 0.95), "
+                    "availability (class 'all' only). Example: "
+                    "'interactive:p95_ms=250@0.95;interactive:"
+                    "hit_rate=0.99;all:availability=0.999'. Needs "
+                    "--history_interval_s; unset = the default "
+                    "objective set")
     ap.add_argument("--incident_dir", default=None,
                     help="directory for flight-recorder incident "
                     "bundles (engine-fatal rebuild, watchdog stall, "
@@ -1241,7 +1443,10 @@ def main(argv=None) -> int:
                         default_priority=args.default_priority,
                         shed_policy=args.shed_policy,
                         flight_recorder=args.flight_recorder == "on",
-                        incident_dir=args.incident_dir)
+                        incident_dir=args.incident_dir,
+                        history_interval_s=args.history_interval_s,
+                        history_samples=args.history_samples,
+                        slo_spec=args.slo_spec)
 
     def _graceful(signum, frame):
         # stop() must run off the serve_forever thread (shutdown()

@@ -79,7 +79,12 @@ client-facing address with fleet semantics:
   (``router_retries_total`` / ``router_hedges_total`` /
   ``router_breaker_open_total`` / ``router_failovers_total`` /
   ``router_probes_total`` / ``router_requests_total`` and the
-  ``router_replica_healthy`` gauge).
+  ``router_replica_healthy`` gauge); ``GET /stats/history`` (round
+  19) rolls every replica's metric time-series into one fleet
+  history — replica rings clock-corrected with the probe-estimated
+  offsets and merged per time bin through ``merge_snapshots``
+  (:meth:`ReplicaRouter.stats_history`), the ``servetop`` fleet
+  view's feed.
 
 ``X-Request-Id`` semantics: the router generates one request id per
 client request (or adopts the client's header) and the SAME id rides
@@ -130,6 +135,7 @@ from typing import Any
 
 from .obs import prom as obs_prom
 from .obs import stitch as obs_stitch
+from .obs import timeseries as obs_ts
 from .obs import trace as obs_trace
 from .obs.flightrec import FlightRecorder
 from .obs.registry import (SERVING_LATENCY_BUCKETS, Registry,
@@ -1221,6 +1227,58 @@ class ReplicaRouter:
             val for ok, val in scraped.values() if ok]
         return obs_prom.render(merge_snapshots(*snaps))
 
+    def stats_history(self) -> dict:
+        """``GET /stats/history``: the FLEET metric time-series — every
+        reachable replica's ``/stats/history`` ring with its timestamps
+        corrected into the router's clock (per-replica offsets
+        estimated NTP-style from the probe clock samples,
+        :func:`~.obs.stitch.estimate_offset` — the same rule the fleet
+        trace stitcher applies), plus one MERGED history
+        (:func:`~.obs.timeseries.rollup` over
+        :func:`~.obs.registry.merge_snapshots`): samples binned on the
+        smallest replica cadence, only bins every live replica covers,
+        so fleet counter series stay monotonic. servetop renders the
+        merged samples as the fleet view and the per-replica payloads
+        as the breakdown."""
+        now = time.perf_counter()
+        samples_by = self.clock_samples()
+        # the history payload is a whole ring (default 600 snapshots —
+        # low MBs of JSON), not a tiny probe: bounding it by the 2 s
+        # probe timeout would intermittently drop healthy-but-loaded
+        # replicas from the rollup (and with them whole fleet bins)
+        scrape_timeout = max(10.0, 5.0 * self.probe_timeout_s)
+        scraped = self._scrape_replicas(
+            lambda r: self._get_json(r, "/stats/history",
+                                     timeout=scrape_timeout)[1])
+        replicas: dict[str, dict] = {}
+        hists: dict[str, list] = {}
+        offsets: dict[str, float] = {}
+        intervals: list[float] = []
+        for r in self.replicas:
+            ok, val = scraped.get(r.name, (False, None))
+            if not ok or not isinstance(val, dict):
+                replicas[r.name] = {"error": f"{type(val).__name__}: "
+                                             f"{val}"}
+                continue
+            off = obs_stitch.estimate_offset(
+                samples_by.get(r.name, ()))
+            offsets[r.name] = round(off, 9)
+            corrected = [[float(t) - off, snap]
+                         for t, snap in val.get("samples", ())]
+            replicas[r.name] = dict(val, process=r.name,
+                                    samples=corrected,
+                                    clock_offset_s=round(off, 9))
+            if val.get("enabled") and corrected:
+                hists[r.name] = [(t, snap) for t, snap in corrected]
+                if val.get("interval_s"):
+                    intervals.append(float(val["interval_s"]))
+        merged = obs_ts.rollup(hists, bin_s=min(intervals)
+                               if intervals else 1.0)
+        return obs_ts.to_payload(
+            merged, enabled=bool(hists), process="router", clock=now,
+            interval_s=min(intervals) if intervals else None,
+            clock_offsets_s=offsets, replicas=replicas)
+
     def fleet_trace(self) -> dict:
         """``GET /trace/fleet``: ONE stitched Perfetto timeline — the
         router's own span drain on top, one process-group per replica
@@ -1314,6 +1372,8 @@ class ReplicaRouter:
                         200 if h["status"] == "live" else 503, h)
                 elif p in ("/stats", f"{scoped}/stats"):
                     self._send_json(200, router.stats())
+                elif p in ("/stats/history", f"{scoped}/stats/history"):
+                    self._send_json(200, router.stats_history())
                 elif p in ("/metrics", f"{scoped}/metrics"):
                     self._send(200, {},
                                router.metrics_text().encode(),

@@ -136,6 +136,18 @@ def _prom(port):
         return prom.parse(r.read().decode())
 
 
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _get_text(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return r.read().decode()
+
+
 def _trace(port, verb):
     req = urllib.request.Request(f"http://127.0.0.1:{port}/trace/{verb}",
                                  data=b"{}")
@@ -852,6 +864,258 @@ def run_overload(export_dir: str, *, vocab: int, seed: int,
     }
 
 
+def run_slo_report(export_dir: str, *, vocab: int, seed: int,
+                   prompt_len: int, max_new: int = 4,
+                   max_queue: int = 3,
+                   interactive_clients: int = 4, requests: int = 3,
+                   deadline_ms: int = 60_000) -> dict:
+    """The ``slo_report`` leg (round 19): the overload-shaped
+    mixed-class workload against a server with the history sampler +
+    SLO objectives armed, reconciled THREE ways — the registry-derived
+    attainment/goodput (what ``servetop`` computes from
+    ``GET /stats/history``) must EXACTLY equal the harness's own
+    per-request outcome ledger AND a replay of the ``--request_log``
+    JSONL events. The induced best_effort burn must produce exactly
+    ONE rate-limited ``slo_burn`` incident bundle whose registry
+    snapshot agrees with the live ``/metrics`` page.
+
+    Determinism without sleeps: ``history_interval_s`` is set far
+    beyond the leg's lifetime, so the ring holds exactly the samples
+    this harness forces — the zero baseline ``start()`` captures and
+    one forced sample per ``GET /stats/history`` poll. No sample
+    lands mid-traffic, so the breach evaluates exactly twice, both
+    after quiesce: the first poll writes THE bundle (quiesced
+    snapshot == live page), the second is suppressed by the per-cause
+    rate limit."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools import servetop
+
+    from distributed_tensorflow_example_tpu.serving_http import \
+        PredictServer
+
+    rs = np.random.RandomState(seed)
+    errors: list[str] = []
+    # the harness ledger: per-class terminal outcomes as the CLIENT
+    # saw them (the ground truth the registry and the request log
+    # must reconcile against)
+    ledger = {cls: {"ok": 0, "good": 0, "shed": 0, "tokens": 0,
+                    "goodput_tokens": 0}
+              for cls in ("interactive", "batch", "best_effort")}
+    ledger_lock = threading.Lock()
+    with tempfile.TemporaryDirectory() as d:
+        req_log = os.path.join(d, "requests.jsonl")
+        inc_dir = os.path.join(d, "incidents")
+        srv = PredictServer(
+            export_dir, max_queue=max_queue, request_log=req_log,
+            incident_dir=inc_dir,
+            history_interval_s=3600.0, history_samples=64,
+            slo_spec=("interactive:hit_rate=0.9;"
+                      "interactive:p95_ms=60000@0.9;"
+                      "best_effort:hit_rate=0.9"),
+            slo_fast_window_s=7200.0, slo_slow_window_s=7200.0,
+            slo_burn_threshold=1.0)
+        srv.start()
+        try:
+            stop = threading.Event()
+
+            def record(cls: str, out: dict) -> None:
+                t = out["timings"][0]
+                with ledger_lock:
+                    ledger[cls]["ok"] += 1
+                    ledger[cls]["tokens"] += t["tokens"]
+                    if t["slo_good"]:
+                        ledger[cls]["good"] += 1
+                        ledger[cls]["goodput_tokens"] += t["tokens"]
+
+            def interactive(ci):
+                for _ in range(requests):
+                    prompt = rs.randint(0, vocab,
+                                        (prompt_len,)).astype(np.int32)
+                    for _attempt in range(100):
+                        try:
+                            out = _post(
+                                srv.port, srv.name, "generate",
+                                {"inputs": {"input_ids":
+                                            [prompt.tolist()]},
+                                 "max_new": max_new,
+                                 "deadline_ms": deadline_ms,
+                                 "priority": "interactive"})
+                            record("interactive", out)
+                            break
+                        except urllib.error.HTTPError as e:
+                            if e.code == 429:
+                                # interactive is never ladder-shed:
+                                # this is the blunt queue-full bound,
+                                # which the SLO counters exclude — the
+                                # closed-loop client retries it
+                                try:
+                                    ra = float(e.headers.get(
+                                        "Retry-After", 0) or 0)
+                                except ValueError:
+                                    ra = 0.0
+                                e.read()
+                                time.sleep(min(max(ra, 0.005), 0.05))
+                                continue
+                            errors.append(f"interactive {ci}: http "
+                                          f"{e.code}")
+                            return
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(f"interactive {ci}: "
+                                          f"{type(e).__name__}: {e}")
+                            return
+                    else:
+                        errors.append(f"interactive {ci}: retry "
+                                      "budget exhausted on 429s")
+                        return
+
+            def best_effort():
+                for _ in range(200):
+                    if stop.is_set():
+                        return
+                    try:
+                        out = _post(srv.port, srv.name, "generate",
+                                    {"inputs": {"input_ids": [[1, 2]]},
+                                     "max_new": 2,
+                                     "priority": "best_effort"})
+                        record("best_effort", out)
+                    except urllib.error.HTTPError as e:
+                        if e.code == 429:
+                            body = e.read().decode(errors="replace")
+                            # only a class SHED enters the SLO served
+                            # counters; the blunt queue-full 429 is a
+                            # pre-admission refusal the client retries
+                            if "shed" in body:
+                                with ledger_lock:
+                                    ledger["best_effort"]["shed"] += 1
+                        else:
+                            errors.append(f"best_effort: http "
+                                          f"{e.code}")
+                    except Exception as e:      # noqa: BLE001
+                        errors.append(f"best_effort: "
+                                      f"{type(e).__name__}: {e}")
+                    time.sleep(0.002)
+
+            threads = [threading.Thread(target=interactive, args=(ci,))
+                       for ci in range(interactive_clients)]
+            be = threading.Thread(target=best_effort)
+            for t in threads:
+                t.start()
+            be.start()
+            for t in threads:
+                t.join()
+            stop.set()
+            be.join()
+            # ---- quiesced: poll #1 forces the breach evaluation ----
+            hist1 = _get_json(srv.port, "/stats/history")
+            bundles = sorted(os.listdir(inc_dir))
+            burn_bundles = [b for b in bundles if "-slo_burn-" in b]
+            bundle_matches = False
+            if burn_bundles:
+                with open(os.path.join(inc_dir, burn_bundles[0])) as f:
+                    bundle = json.load(f)
+                # the bundle snapshot must agree with the live page —
+                # rendered through the same exposition path; only the
+                # http_* counters may differ (each poll advances them
+                # at response time, after the incident landed)
+                from distributed_tensorflow_example_tpu.obs import \
+                    prom as obs_prom
+
+                def page(text):
+                    return "\n".join(
+                        ln for ln in text.splitlines()
+                        if "http_requests_total" not in ln
+                        and "http_errors_total" not in ln)
+
+                live = _get_text(srv.port, "/metrics")
+                bundle_matches = (
+                    page(obs_prom.render(bundle["registry"]))
+                    == page(live))
+            # poll #2: still breaching, must be rate-limit suppressed
+            # (the re-count AFTER it is what proves suppression — the
+            # first count alone could not see a second bundle land)
+            hist2 = _get_json(srv.port, "/stats/history")
+            burn_bundles = [b for b in sorted(os.listdir(inc_dir))
+                            if "-slo_burn-" in b]
+            registry = _prom(srv.port)
+            healthz = _get_json(srv.port, "/healthz")
+        finally:
+            srv.stop()
+        # ---- the three-way reconciliation ---------------------------
+        summary = servetop.compute_summary(hist2)
+        replay = {cls: {"ok": 0, "good": 0, "shed": 0,
+                        "goodput_tokens": 0}
+                  for cls in ("interactive", "batch", "best_effort")}
+        with open(req_log) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("event") != "generate":
+                    continue
+                cls = ev["priority"]
+                if ev["outcome"] == "ok":
+                    replay[cls]["ok"] += 1
+                    if ev["slo_good"]:
+                        replay[cls]["good"] += 1
+                        replay[cls]["goodput_tokens"] += ev["tokens"]
+                elif ev["outcome"] == "shed":
+                    replay[cls]["shed"] += 1
+        diffs: list[str] = []
+
+        def must_eq(what, *vals):
+            if len({json.dumps(v, sort_keys=True)
+                    for v in vals}) != 1:
+                diffs.append(f"{what}: {vals}")
+
+        for cls in ("interactive", "best_effort"):
+            led, rep = ledger[cls], replay[cls]
+            stc = summary["classes"][cls]
+            must_eq(f"{cls} served", led["ok"] + led["shed"],
+                    rep["ok"] + rep["shed"], stc["served"],
+                    int(registry.get(
+                        f"serving_slo_served_{cls}_total", 0)))
+            must_eq(f"{cls} good", led["good"], rep["good"],
+                    stc["good"],
+                    int(registry.get(
+                        f"serving_slo_good_{cls}_total", 0)))
+            must_eq(f"{cls} shed", led["shed"], rep["shed"],
+                    stc["shed"],
+                    int(registry.get(f"serving_shed_{cls}_total", 0)))
+        total_goodput = sum(c["goodput_tokens"]
+                            for c in ledger.values())
+        must_eq("goodput tokens", total_goodput,
+                sum(c["goodput_tokens"] for c in replay.values()),
+                summary["goodput_tokens"],
+                int(registry.get("serving_goodput_tokens_total", 0)))
+        slo_block = (healthz.get("slo") or {})
+        return {
+            "mode": "slo_report",
+            "errors": errors,
+            "interactive_ok": ledger["interactive"]["ok"],
+            "interactive_expected": interactive_clients * requests,
+            "best_effort_shed": ledger["best_effort"]["shed"],
+            "goodput_tokens": total_goodput,
+            "tokens": int(registry.get("serving_tokens_out_total",
+                                       0)),
+            "goodput_tps": summary["goodput_tps"],
+            "throughput_tps": summary["throughput_tps"],
+            "attainment_interactive":
+                summary["classes"]["interactive"]["attainment"],
+            "attainment_best_effort":
+                summary["classes"]["best_effort"]["attainment"],
+            "reconciled": not diffs,
+            "reconcile_diff": diffs,
+            "burn_bundles": len(burn_bundles),
+            "bundle_matches_metrics": bundle_matches,
+            "burn_suppressed": int(registry.get(
+                "serving_incidents_suppressed_total", 0)),
+            "healthz_breaching": slo_block.get("breaching", []),
+            "history_samples": len(hist2.get("samples", ())),
+            "history_samples_first_poll":
+                len(hist1.get("samples", ())),
+        }
+
+
 def thread_sanitizer_check(export_dir: str, prompt) -> tuple[bool, str]:
     """The seeded THR01 violation probe: arm an engine's runtime
     thread sanitizer, let the scheduler thread take ownership (one
@@ -1113,6 +1377,17 @@ def main(argv=None) -> int:
                     dp, vocab=vocab, seed=args.seed,
                     prompt_len=args.prompt_len,
                     max_new=args.max_new)
+                # slo_report leg (round 19): the same overload shape
+                # with the history sampler + objectives armed —
+                # servetop-computed attainment/goodput must reconcile
+                # EXACTLY with the harness ledger and the request-log
+                # replay, and the induced best_effort burn must write
+                # exactly one rate-limited slo_burn bundle agreeing
+                # with live /metrics
+                slo_report_row = run_slo_report(
+                    dp, vocab=vocab, seed=args.seed,
+                    prompt_len=args.prompt_len,
+                    max_new=args.max_new)
             # the int8 leg: same cold matrix against a fully quantized
             # export (int8 weights + int8 KV pool) — gated on the
             # documented drift bound vs the bf16 oracle, plus the
@@ -1210,6 +1485,16 @@ def main(argv=None) -> int:
                 d, matrix, scheduler="on", prompt_len=args.prompt_len,
                 mode_name="flightrec_off",
                 server_kw={"flight_recorder": False})
+            # slo_on leg (round 19): the SAME matrix with the history
+            # sampler + SLO objectives armed — the sampler is a pure
+            # registry reader, so the leg must stay byte- AND
+            # dispatch-identical to rows[0] (armed-vs-plain parity,
+            # the PR-17 flight-recorder pattern)
+            slo_on_row = run_mode(
+                d, matrix, scheduler="on", prompt_len=args.prompt_len,
+                mode_name="slo_on",
+                server_kw={"history_interval_s": 3600.0,
+                           "slo_spec": "interactive:hit_rate=0.99"})
             # router leg (round 15): the same matrix through a
             # 2-replica fleet — greedy bytes must not depend on which
             # replica serves (or on the router being in the path)
@@ -1233,9 +1518,9 @@ def main(argv=None) -> int:
                 stall["on"]["wall_s"] / stall["off"]["wall_s"], 3) \
                 if stall["off"]["wall_s"] else None
             rows += [paged_cold, paged_shared, shared_off, chunked_on,
-                     overload_row, int8_row,
+                     overload_row, slo_report_row, int8_row,
                      tsan_row, chaos_row, spec_off_row, spec_row,
-                     flightrec_off_row, router_row]
+                     flightrec_off_row, slo_on_row, router_row]
             # always-on tps / recorder-off tps: ~1.0 expected (the
             # ring's per-span cost is µs against ms-scale dispatches);
             # reported, not gated — CPU smoke noise would make a
@@ -1274,6 +1559,36 @@ def main(argv=None) -> int:
                 ("overload_p95_within_deadline",
                  overload_row["latency_p95_ms"]
                  <= overload_row["deadline_ms"]),
+                # round-19 gates: the measurement half of the SLO
+                # story — exact three-way reconciliation, exactly one
+                # rate-limited slo_burn bundle agreeing with the live
+                # page, goodput visible and bounded by throughput,
+                # and the armed sampler a provable no-op
+                ("slo_report_reconciles",
+                 slo_report_row["reconciled"]
+                 and not slo_report_row["errors"]),
+                ("slo_report_interactive_all_served",
+                 slo_report_row["interactive_ok"]
+                 == slo_report_row["interactive_expected"]),
+                ("slo_report_sheds_best_effort",
+                 slo_report_row["best_effort_shed"] > 0),
+                ("slo_burn_exactly_one_bundle",
+                 slo_report_row["burn_bundles"] == 1),
+                ("slo_burn_rate_limited",
+                 slo_report_row["burn_suppressed"] >= 1),
+                ("slo_burn_bundle_matches_metrics",
+                 slo_report_row["bundle_matches_metrics"]),
+                ("slo_burn_advisory_on_healthz",
+                 "best_effort:hit_rate"
+                 in slo_report_row["healthz_breaching"]),
+                ("slo_goodput_positive_and_bounded",
+                 0 < slo_report_row["goodput_tokens"]
+                 <= slo_report_row["tokens"]),
+                ("slo_on_parity_with_plain",
+                 slo_on_row["_gens"] == rows[0]["_gens"]),
+                ("slo_on_dispatch_parity",
+                 (slo_on_row["decode_steps"], slo_on_row["prefills"])
+                 == (rows[0]["decode_steps"], rows[0]["prefills"])),
                 ("chunk_stall_parity", stall["parity"]),
                 ("chunk_stall_bounded_below_monolithic",
                  stall["on"]["stall_max_ms"]

@@ -119,6 +119,53 @@ def build_trace_summary() -> str:
             f"{stats['dropped']} dropped, {check}")
 
 
+def build_slo_summary() -> str:
+    """One-line tier-1 SLO summary: objectives parse + a pure
+    attainment/burn self-check on a fabricated two-sample smoke
+    history (10 interactive requests, 8 good — attainment 0.8, burn
+    4.0 against a 0.95 goal, breach over equal windows). Prints only
+    when the suite actually registered the serving_slo_* counters
+    (a serving-flavored run), and a failure prints as FAILED rather
+    than hiding. The dead-counter side of the story rides the
+    TELEMETRY line: a serving_slo_* name nothing incremented shows
+    up there as DEAD."""
+    from distributed_tensorflow_example_tpu.obs import slo as obs_slo
+    from distributed_tensorflow_example_tpu.obs.registry import (
+        Registry, process_metric_names)
+    if not any(n.startswith("serving_slo_")
+               for n in process_metric_names()):
+        return ""
+    try:
+        objectives = obs_slo.default_objectives() + \
+            obs_slo.parse_slo_spec("interactive:hit_rate=0.95")
+
+        def snap(served, good):
+            reg = Registry()
+            reg.counter("serving_slo_served_interactive_total").inc(
+                served)
+            reg.counter("serving_slo_good_interactive_total").inc(
+                good)
+            return reg.snapshot()
+
+        hist = [(0.0, snap(0, 0)), (60.0, snap(10, 8))]
+        res = obs_slo.evaluate(
+            hist, [o for o in objectives
+                   if o.key() == "interactive:hit_rate"
+                   and o.goal == 0.95],
+            fast_s=60.0, slow_s=60.0, threshold=2.0)
+        r = res[0]
+        ok = (r["attainment"] == 0.8
+              and abs(r["burn_fast"] - 4.0) < 1e-9 and r["breach"])
+        check = ("attainment self-check ok (0.8 @ goal 0.95 -> "
+                 "burn 4.0, breach)" if ok
+                 else f"attainment self-check FAILED ({r})")
+        return (f"SLO: {len(objectives)} objective(s) loaded "
+                f"({len(obs_slo.default_objectives())} default + "
+                f"spec), {check}")
+    except Exception as e:      # the banner must never mask results
+        return f"SLO: self-check FAILED ({type(e).__name__}: {e})"
+
+
 def build_graftlint_summary() -> str:
     """One-line graftlint summary for the tier-1 banner: rule count,
     finding count (tier-1 requires 0 — tests/test_graftlint.py is the
@@ -159,15 +206,21 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     except Exception:
         trace = ""
     try:
+        slo = build_slo_summary()
+    except Exception:
+        slo = ""
+    try:
         lint = build_graftlint_summary()
     except Exception:
         lint = ""
-    if tele or trace or lint:
+    if tele or trace or slo or lint:
         terminalreporter.section("TIER-1 TELEMETRY", sep="-")
         if tele:
             terminalreporter.line(tele)
         if trace:
             terminalreporter.line(trace)
+        if slo:
+            terminalreporter.line(slo)
         if lint:
             terminalreporter.line(lint)
     failed = [r.nodeid for r in terminalreporter.stats.get("failed", [])]

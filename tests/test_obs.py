@@ -441,6 +441,50 @@ def test_quantile_from_parsed():
         prom.quantile_from_parsed(parsed, "lat_seconds", 1.5)
 
 
+def test_quantile_edge_cases_pinned():
+    """The edge cases the window-quantile queries (obs/timeseries.py)
+    lean on, pinned BEFORE the SLO layer trusts them: an EMPTY
+    histogram is 0.0 (no observations, no percentile); an
+    all-mass-in-+Inf histogram (every observation beyond the last
+    finite bound — the saturated case the load harness's bucket audit
+    hunts) clamps to the largest FINITE bound at every q; a
+    single-bucket histogram interpolates from 0 within its one bound
+    and never exceeds it."""
+    reg = Registry()
+    # empty: count == 0
+    reg.histogram("empty_seconds", buckets=(0.1, 1.0))
+    parsed = prom.parse(prom.render(reg.snapshot()))
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert prom.quantile_from_parsed(parsed, "empty_seconds",
+                                         q) == 0.0
+    # saturated: all observations in +Inf -> the conventional clamp,
+    # the largest finite bound, at EVERY rank (never inf, never 0)
+    h = reg.histogram("sat_seconds", buckets=(0.1, 1.0))
+    for _ in range(7):
+        h.observe(50.0)
+    parsed = prom.parse(prom.render(reg.snapshot()))
+    for q in (0.01, 0.5, 0.99):
+        assert prom.quantile_from_parsed(parsed, "sat_seconds",
+                                         q) == 1.0
+    # single bucket: linear interpolation from 0 within the one bound
+    h1 = reg.histogram("one_seconds", buckets=(2.0,))
+    for _ in range(4):
+        h1.observe(1.0)
+    parsed = prom.parse(prom.render(reg.snapshot()))
+    assert prom.quantile_from_parsed(parsed, "one_seconds",
+                                     0.5) == pytest.approx(1.0)
+    assert prom.quantile_from_parsed(parsed, "one_seconds",
+                                     1.0) == pytest.approx(2.0)
+    # single bucket + +Inf mass: rank inside the finite bucket still
+    # interpolates; rank beyond it clamps to the finite bound
+    h1.observe(10.0)
+    parsed = prom.parse(prom.render(reg.snapshot()))
+    assert prom.quantile_from_parsed(parsed, "one_seconds",
+                                     0.4) == pytest.approx(1.0)
+    assert prom.quantile_from_parsed(parsed, "one_seconds",
+                                     0.99) == 2.0
+
+
 # ----------------------------------------------------- training telemetry
 def test_trainer_registry_and_trace_lanes(tmp_path):
     """The trainer side of the telemetry story: train() with

@@ -1,0 +1,236 @@
+"""Metric time-series (obs/timeseries.py): the bounded snapshot ring
+and the pure window queries the SLO layer leans on.
+
+Everything runs on fabricated histories with hand-driven clocks — the
+sampler's injectable clock and explicit :meth:`~.SnapshotSampler.
+sample` calls mean not one test here sleeps.
+"""
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import timeseries as ts
+from distributed_tensorflow_example_tpu.obs.registry import Registry
+
+
+def _snap(served=0, good=0, tokens=0, lat=()):
+    """A real registry snapshot with the SLO-shaped metrics — built
+    through the Registry itself so the record layout can never drift
+    from what the sampler actually captures."""
+    reg = Registry()
+    c = reg.counter("serving_slo_served_total")
+    g = reg.counter("serving_slo_good_total")
+    t = reg.counter("serving_tokens_out_total")
+    h = reg.histogram("serving_request_latency_seconds",
+                      buckets=(0.1, 1.0, 10.0))
+    c.inc(served)
+    g.inc(good)
+    t.inc(tokens)
+    for v in lat:
+        h.observe(v)
+    return reg.snapshot()
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------- sampler
+def test_sampler_ring_bound_and_injected_clock():
+    clock = FakeClock()
+    state = {"served": 0}
+
+    def snap():
+        return _snap(served=state["served"])
+
+    s = ts.SnapshotSampler(snap, interval_s=1.0, max_samples=3,
+                           clock=clock)
+    for i in range(5):
+        clock.t = float(i)
+        state["served"] = i * 10
+        s.sample()
+    hist = s.history()
+    assert len(hist) == 3                      # bounded: oldest dropped
+    assert [t for t, _ in hist] == [2.0, 3.0, 4.0]
+    assert hist[-1][1]["serving_slo_served_total"]["value"] == 40
+
+
+def test_sampler_on_sample_hook_runs_and_never_raises_out():
+    seen = []
+
+    def hook(sampler):
+        seen.append(len(sampler))
+        raise RuntimeError("evaluator blew up")
+
+    s = ts.SnapshotSampler(lambda: _snap(), clock=FakeClock(),
+                           on_sample=hook)
+    s.sample()                                 # must not raise
+    s.sample()
+    assert seen == [1, 2]
+
+
+def test_sampler_rejects_bad_config():
+    with pytest.raises(ValueError, match="interval_s"):
+        ts.SnapshotSampler(dict, interval_s=0)
+    with pytest.raises(ValueError, match="max_samples"):
+        ts.SnapshotSampler(dict, max_samples=1)
+
+
+def test_sampler_thread_start_stop_and_immediate_first_sample():
+    """start() captures the baseline immediately (no interval wait),
+    so a window over a fresh server's ring includes t=0; stop() parks
+    the thread even though the interval is an hour."""
+    s = ts.SnapshotSampler(lambda: _snap(), interval_s=3600.0)
+    s.start()
+    try:
+        import time
+        deadline = time.monotonic() + 5.0
+        while len(s) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(s) >= 1
+    finally:
+        s.stop()
+    assert s._thread is None
+
+
+# ------------------------------------------------------- window queries
+@pytest.fixture
+def history():
+    return [
+        (0.0, _snap(served=0, good=0, tokens=0, lat=[])),
+        (10.0, _snap(served=4, good=4, tokens=40, lat=[0.05] * 4)),
+        (20.0, _snap(served=10, good=7, tokens=100,
+                     lat=[0.05] * 4 + [0.5] * 6)),
+    ]
+
+
+def test_window_selects_by_newest_sample_not_wall_clock(history):
+    assert len(ts.window(history, None)) == 3
+    assert [t for t, _ in ts.window(history, 10.0)] == [10.0, 20.0]
+    assert [t for t, _ in ts.window(history, 5.0)] == [20.0]
+    assert ts.window([], 10.0) == []
+
+
+def test_window_with_explicit_now_excludes_the_future(history):
+    """Offline replay at a mid-history instant: samples NEWER than
+    ``now`` must be cut too — a burn evaluated at t=10 computed from
+    the t=20 sample would page for errors that had not happened yet."""
+    assert [t for t, _ in ts.window(history, 60.0, now=10.0)] \
+        == [0.0, 10.0]
+    assert [t for t, _ in ts.window(history, None, now=10.0)] \
+        == [0.0, 10.0]
+    assert [t for t, _ in ts.window(history, 5.0, now=12.0)] == [10.0]
+    # the replayed instant sees only its own past in the deltas
+    assert ts.delta(ts.window(history, 60.0, now=10.0),
+                    "serving_slo_served_total") == 4
+
+
+def test_delta_and_rate(history):
+    assert ts.delta(history, "serving_slo_served_total") == 10
+    assert ts.rate_per_s(history, "serving_tokens_out_total") == \
+        pytest.approx(5.0)
+    # sub-window: only the second half's counts
+    win = ts.window(history, 10.0)
+    assert ts.delta(win, "serving_slo_served_total") == 6
+    assert ts.rate_per_s(win, "serving_tokens_out_total") == \
+        pytest.approx(6.0)
+    # degenerate windows: no rate, no delta
+    assert ts.rate_per_s(win[-1:], "serving_tokens_out_total") == 0.0
+    assert ts.delta(win[-1:], "serving_slo_served_total") == 0
+    assert ts.delta(history, "absent_total") == 0
+    with pytest.raises(ValueError, match="histogram"):
+        ts.rate_per_s(history, "serving_request_latency_seconds")
+
+
+def test_histogram_delta_and_window_quantile(history):
+    d = ts.delta(history, "serving_request_latency_seconds")
+    assert d["count"] == 10
+    assert d["buckets"] == [(0.1, 4), (1.0, 6), (10.0, 0)]
+    # full window: 4 obs <= 0.1, 6 in (0.1, 1.0] -> p95 inside the
+    # second bucket, p30 inside the first
+    assert 0.1 < ts.quantile(history, "serving_request_latency_seconds",
+                             0.95) <= 1.0
+    assert ts.quantile(history, "serving_request_latency_seconds",
+                       0.3) <= 0.1
+    # the 10s window saw ONLY the six 0.5s observations — the windowed
+    # quantile must ignore the fast first wave entirely
+    win = ts.window(history, 10.0)
+    assert ts.quantile(win, "serving_request_latency_seconds",
+                       0.5) > 0.1
+    # empty/degenerate -> 0.0 (same convention as an empty histogram)
+    assert ts.quantile(win[-1:], "serving_request_latency_seconds",
+                       0.5) == 0.0
+
+
+def test_good_below_interpolates(history):
+    name = "serving_request_latency_seconds"
+    # at a bucket bound: exact cumulative count
+    assert ts.good_below(history, name, 0.1) == 4
+    assert ts.good_below(history, name, 1.0) == 10
+    # inside the (0.1, 1.0] bucket: linear share of its 6 observations
+    mid = ts.good_below(history, name, 0.55)
+    assert 4 < mid < 10
+    assert mid == pytest.approx(4 + 6 * (0.55 - 0.1) / 0.9)
+    assert ts.good_below(history, name, float("inf")) == 10
+    assert ts.good_below(history[-1:], name, 1.0) == 0.0
+
+
+# ------------------------------------------------------------- rollup
+def test_rollup_merges_with_clock_offsets():
+    """Two replicas sampling the same instants in DIFFERENT clocks
+    (replica B's clock runs 100s ahead): with the estimated offsets
+    applied, bins align and counters SUM per bin."""
+    a = [(0.0, _snap(served=1)), (10.0, _snap(served=3))]
+    b = [(100.5, _snap(served=10)), (110.5, _snap(served=30))]
+    merged = ts.rollup({"a": a, "b": b},
+                       offsets={"b": 100.0}, bin_s=2.0)
+    assert len(merged) == 2
+    assert [round(t, 1) for t, _ in merged] == [0.5, 10.5]
+    assert [s["serving_slo_served_total"]["value"]
+            for _, s in merged] == [11, 33]
+
+
+def test_rollup_skips_bins_missing_a_replica():
+    """A bin one replica never covered is dropped — merging the others
+    alone would render a fleet-wide counter DIP."""
+    a = [(0.0, _snap(served=1)), (10.0, _snap(served=2)),
+         (20.0, _snap(served=3))]
+    b = [(0.0, _snap(served=5)), (20.0, _snap(served=7))]
+    merged = ts.rollup({"a": a, "b": b}, bin_s=1.0)
+    assert [int(t) for t, _ in merged] == [0, 20]
+    vals = [s["serving_slo_served_total"]["value"] for _, s in merged]
+    assert vals == [6, 10]
+    assert vals == sorted(vals)                # monotonic by design
+
+
+def test_rollup_takes_newest_sample_per_bin_and_validates():
+    a = [(0.0, _snap(served=1)), (0.9, _snap(served=2))]
+    merged = ts.rollup({"a": a}, bin_s=2.0)
+    assert len(merged) == 1
+    assert merged[0][1]["serving_slo_served_total"]["value"] == 2
+    assert ts.rollup({}) == []
+    assert ts.rollup({"a": []}) == []
+    with pytest.raises(ValueError, match="bin_s"):
+        ts.rollup({"a": a}, bin_s=0)
+
+
+def test_payload_roundtrip(history):
+    """JSON round-trip preserves everything the queries read (bucket
+    TUPLES come back as lists — both shapes are first-class for every
+    window query, so equality is checked through json itself)."""
+    import json
+    payload = ts.to_payload(history, process="replica0", enabled=True)
+    assert payload["process"] == "replica0"
+    back = ts.parse_payload(json.loads(json.dumps(payload)))
+    assert [t for t, _ in back] == [t for t, _ in history]
+    assert json.dumps([s for _, s in back], sort_keys=True) \
+        == json.dumps([s for _, s in history], sort_keys=True)
+    # and the queries agree across the round-trip
+    assert ts.delta(back, "serving_slo_served_total") \
+        == ts.delta(history, "serving_slo_served_total")
+    assert ts.quantile(back, "serving_request_latency_seconds", 0.95) \
+        == ts.quantile(history, "serving_request_latency_seconds",
+                       0.95)

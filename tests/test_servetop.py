@@ -1,0 +1,137 @@
+"""tools/servetop.py: the SLO & goodput renderer.
+
+compute_summary is pure (fabricated payloads, no network, no clocks),
+and the offline ``--file`` mode is driven through main() — the same
+path an operator uses on a dumped history or an slo_burn bundle's
+tail. The live-poll path is exercised end-to-end by the serving_load
+``slo_report`` smoke leg, which reconciles compute_summary against
+the harness ledger exactly.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from distributed_tensorflow_example_tpu.obs.registry import (  # noqa: E402
+    Registry)
+from tools import servetop  # noqa: E402
+
+
+def _snap(interactive=(0, 0), best_effort=(0, 0), tokens=0,
+          goodput=0, shed_be=0, queue=0, pressure=0):
+    reg = Registry()
+    for cls, (served, good) in (("interactive", interactive),
+                                ("batch", (0, 0)),
+                                ("best_effort", best_effort)):
+        reg.counter(f"serving_slo_served_{cls}_total").inc(served)
+        reg.counter(f"serving_slo_good_{cls}_total").inc(good)
+        reg.histogram(f"serving_latency_{cls}_seconds",
+                      buckets=(0.1, 1.0))
+    reg.counter("serving_slo_served_total").inc(
+        interactive[0] + best_effort[0])
+    reg.counter("serving_slo_good_total").inc(
+        interactive[1] + best_effort[1])
+    reg.counter("serving_tokens_out_total").inc(tokens)
+    reg.counter("serving_goodput_tokens_total").inc(goodput)
+    reg.counter("serving_shed_total").inc(shed_be)
+    reg.counter("serving_shed_interactive_total")
+    reg.counter("serving_shed_batch_total")
+    reg.counter("serving_shed_best_effort_total").inc(shed_be)
+    reg.gauge("serving_queue_depth").set(queue)
+    reg.gauge("serving_queue_age_seconds").set(0.0)
+    reg.gauge("serving_pressure_level").set(pressure)
+    return reg.snapshot()
+
+
+@pytest.fixture
+def payload():
+    return {
+        "enabled": True, "process": "serving", "clock": 20.0,
+        "interval_s": 10.0,
+        "samples": [
+            [0.0, _snap()],
+            [10.0, _snap(interactive=(4, 4), tokens=40, goodput=40)],
+            [20.0, _snap(interactive=(8, 7), best_effort=(4, 2),
+                         tokens=100, goodput=80, shed_be=2,
+                         queue=3, pressure=1)],
+        ],
+        "slo": {"results": [
+            {"class": "best_effort", "kind": "hit_rate",
+             "target": 0.9, "goal": 0.9, "attainment": 0.5,
+             "burn_fast": 5.0, "burn_slow": 5.0, "breach": True}]},
+    }
+
+
+def test_compute_summary_is_exact(payload):
+    s = servetop.compute_summary(payload)
+    assert s["enabled"] and s["samples"] == 3
+    assert s["window_s"] == 20.0
+    assert s["throughput_tps"] == pytest.approx(5.0)
+    assert s["goodput_tps"] == pytest.approx(4.0)
+    assert s["served"] == 12 and s["good"] == 9
+    assert s["goodput_tokens"] == 80 and s["tokens"] == 100
+    assert s["queue_depth"] == 3
+    assert s["pressure"] == "shed_best_effort"
+    ci = s["classes"]["interactive"]
+    assert (ci["served"], ci["good"], ci["shed"]) == (8, 7, 0)
+    assert ci["attainment"] == pytest.approx(7 / 8)
+    cb = s["classes"]["best_effort"]
+    assert (cb["served"], cb["good"], cb["shed"]) == (4, 2, 2)
+    assert s["classes"]["batch"]["attainment"] is None
+    assert s["slo"][0]["breach"] is True
+
+
+def test_compute_summary_windowed(payload):
+    s = servetop.compute_summary(payload, window_s=10.0)
+    # only the last 10s: the second wave's deltas
+    assert s["served"] == 8 and s["tokens"] == 60
+    assert s["classes"]["interactive"]["served"] == 4
+
+
+def test_compute_summary_fleet_breakdown(payload):
+    payload["process"] = "router"
+    payload["replicas"] = {
+        "replica0": {"enabled": True, "clock_offset_s": 0.000123,
+                     "samples": payload["samples"]},
+        "replica1": {"error": "ConnectionRefusedError: dead"},
+    }
+    s = servetop.compute_summary(payload)
+    r0 = s["replicas"]["replica0"]
+    assert r0["served"] == 12
+    assert r0["attainment"] == pytest.approx(9 / 12)
+    assert r0["clock_offset_s"] == 0.000123
+    assert "error" in s["replicas"]["replica1"]
+
+
+def test_render_frame_mentions_the_story(payload):
+    payload["replicas"] = {
+        "replica0": {"enabled": True, "clock_offset_s": 0.0,
+                     "samples": payload["samples"]}}
+    text = servetop.render(servetop.compute_summary(payload))
+    for needle in ("goodput", "interactive", "best_effort",
+                   "BREACH", "replica0", "shed_best_effort"):
+        assert needle in text, needle
+    # a sampler-off payload renders the how-to-arm hint, not a crash
+    off = servetop.render(servetop.compute_summary(
+        {"enabled": False, "process": "serving", "samples": []}))
+    assert "--history_interval_s" in off
+
+
+def test_main_offline_file_mode(tmp_path, capsys, payload):
+    p = tmp_path / "hist.json"
+    p.write_text(json.dumps(payload))
+    assert servetop.main(["--file", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "servetop — serving" in out
+    assert servetop.main(["--file", str(p), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["served"] == 12
+    # windowed offline render
+    assert servetop.main(["--file", str(p), "--json",
+                          "--window", "10"]) == 0
+    assert json.loads(capsys.readouterr().out)["served"] == 8

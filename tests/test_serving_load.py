@@ -28,9 +28,9 @@ def test_smoke_runs_and_holds_parity(capsys):
     modes = {r["mode"]: r for r in rows if "mode" in r}
     assert set(modes) == {"scheduler_on", "scheduler_off", "paged_cold",
                           "paged_shared", "shared_off", "chunked_on",
-                          "overload", "int8_on",
+                          "overload", "slo_report", "int8_on",
                           "tsan_on", "chaos_on", "spec_off", "spec_on",
-                          "flightrec_off", "router_on"}
+                          "flightrec_off", "slo_on", "router_on"}
     on = modes["scheduler_on"]
     assert on["requests"] == 4 and not on["errors"]
     assert on["tokens_per_s"] > 0 and on["latency_p95_ms"] > 0
@@ -147,6 +147,31 @@ def test_smoke_runs_and_holds_parity(capsys):
     assert s["chunk_stall_bounded_below_monolithic"] is True
     assert s["chunk_stall_p95_drops"] is True
     assert s["chunk_stall_on_ms"] < s["chunk_stall_off_ms"]
+    # round-19 gates: the SLO measurement layer — armed sampler is a
+    # provable no-op (byte + dispatch parity), the slo_report leg
+    # reconciles EXACTLY three ways (registry == harness ledger ==
+    # request-log replay == servetop), the induced burn writes
+    # exactly one rate-limited slo_burn bundle agreeing with live
+    # /metrics, the advisory rides /healthz, and goodput is visible
+    # and bounded by raw throughput
+    assert s["slo_on_parity_with_plain"] is True
+    assert s["slo_on_dispatch_parity"] is True
+    assert s["slo_report_reconciles"] is True
+    assert s["slo_report_interactive_all_served"] is True
+    assert s["slo_report_sheds_best_effort"] is True
+    assert s["slo_burn_exactly_one_bundle"] is True
+    assert s["slo_burn_rate_limited"] is True
+    assert s["slo_burn_bundle_matches_metrics"] is True
+    assert s["slo_burn_advisory_on_healthz"] is True
+    assert s["slo_goodput_positive_and_bounded"] is True
+    rep = modes["slo_report"]
+    assert not rep["errors"] and rep["reconcile_diff"] == []
+    assert rep["attainment_interactive"] == 1.0
+    assert rep["attainment_best_effort"] is not None
+    assert rep["attainment_best_effort"] < 1.0
+    assert rep["goodput_tps"] <= rep["throughput_tps"]
+    assert rep["healthz_breaching"] == ["best_effort:hit_rate"]
+    assert not modes["slo_on"]["errors"]
 
 
 def test_smoke_rejects_thread_sanitizer_flag(capsys):
@@ -190,6 +215,14 @@ def test_bench_serving_row_publishes_keys():
     assert row["serving_spec_errors"] == 0
     assert 0.0 <= row["serving_accept_rate"] <= 1.0
     assert row["serving_spec_tokens_per_dispatch"] > 0
+    # round-19 SLO columns (gpt_serving_goodput_tps /
+    # gpt_serving_slo_attainment* after key prefixing): goodput is
+    # registry-sourced deadline-met tokens/s — on this deadline-less
+    # matrix every token is good, so it must equal raw tps exactly
+    # (same tokens, same wall) and attainment must be 1.0
+    assert row["serving_goodput_tps"] == row["serving_tps"]
+    assert row["serving_slo_attainment"] == 1.0
+    assert row["serving_slo_attainment_interactive"] == 1.0
     # round-17 fleet columns (gpt_router_p95_ms /
     # gpt_router_failover_total / gpt_router_hedge_win_rate after key
     # prefixing) — the serving-fleet BENCH trajectory's first rows,

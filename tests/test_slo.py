@@ -1,0 +1,553 @@
+"""SLO attainment & goodput observability (round 19, DESIGN.md §22).
+
+- pure burn-rate math (obs/slo.py): spec grammar, the three SLI
+  kinds, the multi-window breach rule — all on fabricated histories,
+  zero sleeps;
+- engine-level terminal-outcome accounting: every outcome (ok, shed,
+  expired, cancelled) feeds the per-class serving_slo_* counters
+  EXACTLY once, goodput counts only deadline-met tokens, and the
+  request-log JSONL event carries the round-19 schema (priority /
+  deadline_ms / outcome / slo_good — the satellite completeness fix);
+- serving_http: GET /stats/history (forced sample + ring), the
+  /healthz advisory slo block, and the deterministic slo_burn
+  incident path (breach -> exactly one rate-limited bundle);
+- serving_router: the fleet /stats/history rollup over fake replicas
+  with a known clock offset.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "experiments"))
+sys.path.insert(0, ROOT)
+
+import serving_load  # noqa: E402
+
+from distributed_tensorflow_example_tpu.obs import slo as obs_slo  # noqa: E402
+from distributed_tensorflow_example_tpu.obs.registry import Registry  # noqa: E402
+from distributed_tensorflow_example_tpu.serving import load_stepwise  # noqa: E402
+from distributed_tensorflow_example_tpu.serving_batch import (  # noqa: E402
+    DeadlineExceededError, GenerationEngine, RequestCancelledError,
+    ShedError)
+from distributed_tensorflow_example_tpu.serving_http import (  # noqa: E402
+    PredictServer)
+from distributed_tensorflow_example_tpu.serving_router import (  # noqa: E402
+    Replica, ReplicaRouter)
+from distributed_tensorflow_example_tpu.utils.metrics import (  # noqa: E402
+    MetricsLogger)
+
+PROMPT_LEN = 12
+MAX_NEW = 6
+SLOTS = 3
+BLOCK = 4
+
+
+@pytest.fixture(scope="module")
+def export_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("slo_obs"))
+    vocab = serving_load.build_export(
+        d, prompt_len=PROMPT_LEN, max_new=MAX_NEW, slots=SLOTS,
+        seed=0, paged=True, block_size=BLOCK)
+    return d, vocab
+
+
+def _prompt(vocab, n=5, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, vocab, (n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# pure math: spec grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_slo_spec_grammar():
+    objs = obs_slo.parse_slo_spec(
+        "interactive:p95_ms=250@0.9;interactive:hit_rate=0.99;"
+        "all:availability=0.999")
+    assert [o.key() for o in objs] == [
+        "interactive:p95_ms", "interactive:hit_rate",
+        "all:availability"]
+    assert objs[0].target == 250.0 and objs[0].goal == 0.9
+    assert objs[1].goal == 0.99
+    # p95 goal defaults to 0.95
+    assert obs_slo.parse_slo_spec("batch:p95_ms=100")[0].goal == 0.95
+
+
+@pytest.mark.parametrize("bad,match", [
+    ("interactive:p95_ms", "expected"),
+    ("p95_ms=250", "class:kind"),
+    ("interactive:nope=0.9", "kind"),
+    ("wrong:hit_rate=0.9", "class"),
+    ("interactive:hit_rate=0.9@0.8", "no @goal"),
+    ("interactive:hit_rate=1.5", "goal"),
+    ("interactive:p95_ms=0@0.9", "target"),
+    ("best_effort:availability=0.9", "all"),
+    ("interactive:hit_rate=0.9;interactive:hit_rate=0.8", "repeats"),
+    (";;", "no objectives"),
+])
+def test_parse_slo_spec_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        obs_slo.parse_slo_spec(bad)
+
+
+def test_default_objectives_are_valid():
+    objs = obs_slo.default_objectives()
+    assert len(objs) == 3
+    assert {o.kind for o in objs} == {"p95_ms", "hit_rate",
+                                      "availability"}
+
+
+# ---------------------------------------------------------------------------
+# pure math: SLIs and multi-window burn
+# ---------------------------------------------------------------------------
+
+def _snap(cls="interactive", served=0, good=0, failed=0, lat=()):
+    reg = Registry()
+    reg.counter(f"serving_slo_served_{cls}_total").inc(served)
+    reg.counter(f"serving_slo_good_{cls}_total").inc(good)
+    reg.counter("serving_slo_served_total").inc(served)
+    reg.counter("serving_slo_good_total").inc(good)
+    reg.counter("serving_requests_failed_total").inc(failed)
+    h = reg.histogram(f"serving_latency_{cls}_seconds",
+                      buckets=(0.1, 1.0))
+    for v in lat:
+        h.observe(v)
+    return reg.snapshot()
+
+
+def test_sli_hit_rate_and_burn():
+    hist = [(0.0, _snap()), (60.0, _snap(served=20, good=18))]
+    obj = obs_slo.Objective("interactive", "hit_rate", 0.95, 0.95)
+    good, total = obs_slo.sli(hist, obj)
+    assert (good, total) == (18.0, 20.0)
+    # err 0.1 over budget 0.05 -> burn 2.0
+    assert obs_slo.burn_rate(good, total, 0.95) == pytest.approx(2.0)
+    assert obs_slo.burn_rate(0, 0, 0.95) == 0.0      # idle: no burn
+
+
+def test_sli_availability_and_p95():
+    hist = [(0.0, _snap()),
+            (60.0, _snap(served=10, good=10, failed=2,
+                         lat=[0.05] * 8 + [0.5] * 2))]
+    avail = obs_slo.Objective("all", "availability", 0.999, 0.999)
+    good, total = obs_slo.sli(hist, avail)
+    assert (good, total) == (8.0, 10.0)
+    p95 = obs_slo.Objective("interactive", "p95_ms", 100.0, 0.9)
+    good, total = obs_slo.sli(hist, p95)
+    assert total == 10.0
+    assert good == pytest.approx(8.0)     # the 100ms bound = bucket 0.1
+    # empty window -> (0, 0)
+    assert obs_slo.sli(hist[-1:], p95) == (0.0, 0.0)
+
+
+def test_evaluate_multi_window_breach_rule():
+    """Breach needs BOTH windows burning: a long-quiet history with
+    one recent bad burst trips the fast window only (slow window
+    dilutes it below threshold) -> no breach; sustained errors trip
+    both -> breach; a recovered incident (errors old, fast window
+    clean) -> no breach."""
+    obj = [obs_slo.Objective("interactive", "hit_rate", 0.9, 0.9)]
+
+    def ev(hist, now):
+        return obs_slo.evaluate(hist, obj, now=now, fast_s=60.0,
+                                slow_s=600.0, threshold=2.0)[0]
+
+    # sustained: every request bad in both windows
+    sustained = [(0.0, _snap()),
+                 (550.0, _snap(served=50, good=25)),
+                 (600.0, _snap(served=100, good=50))]
+    r = ev(sustained, 600.0)
+    assert r["burn_fast"] == pytest.approx(5.0)
+    assert r["burn_slow"] == pytest.approx(5.0)
+    assert r["breach"] and r["attainment"] == pytest.approx(0.5)
+    # recent-burst-only: slow window dilutes below threshold
+    burst = [(0.0, _snap()),
+             (540.0, _snap(served=1000, good=1000)),
+             (600.0, _snap(served=1010, good=1005))]
+    r = ev(burst, 600.0)
+    assert r["burn_fast"] == pytest.approx(5.0)
+    assert r["burn_slow"] < 2.0
+    assert not r["breach"]
+    # recovered: errors outside the fast window
+    recovered = [(0.0, _snap()),
+                 (500.0, _snap(served=100, good=50)),
+                 (599.0, _snap(served=100, good=50)),
+                 (600.0, _snap(served=100, good=50))]
+    r = ev(recovered, 600.0)
+    assert r["burn_fast"] == 0.0 and r["burn_slow"] > 2.0
+    assert not r["breach"]
+
+
+def test_summarize_names_breaching_and_worst():
+    results = obs_slo.evaluate(
+        [(0.0, _snap()), (10.0, _snap(served=10, good=0))],
+        [obs_slo.Objective("interactive", "hit_rate", 0.9, 0.9),
+         obs_slo.Objective("all", "availability", 0.999, 0.999)],
+        fast_s=60.0, slow_s=60.0, threshold=2.0)
+    s = obs_slo.summarize(results)
+    assert s["objectives"] == 2
+    assert "interactive:hit_rate" in s["breaching"]
+    assert s["worst_burn"]["burn_fast"] >= 10.0
+    assert obs_slo.summarize([]) == {
+        "objectives": 0, "breaching": [], "worst_burn": None}
+
+
+# ---------------------------------------------------------------------------
+# engine: terminal-outcome accounting + request-log schema
+# ---------------------------------------------------------------------------
+
+def test_engine_counts_every_class_and_goodput(export_dir):
+    """One retired request per priority class: served == good per
+    class, per-class latency histograms observe, and goodput counts
+    exactly the emitted tokens (no deadlines -> every token good)."""
+    d, vocab = export_dir
+    eng = GenerationEngine(load_stepwise(d)).start()
+    try:
+        handles = [eng.submit(_prompt(vocab, seed=i), max_new=3,
+                              priority=cls)
+                   for i, cls in enumerate(
+                       ("interactive", "batch", "best_effort"))]
+        for h in handles:
+            h.result(timeout=120)
+        snap = eng.metrics_snapshot()
+    finally:
+        eng.close()
+    for cls in ("interactive", "batch", "best_effort"):
+        assert snap[f"serving_slo_served_{cls}_total"]["value"] == 1
+        assert snap[f"serving_slo_good_{cls}_total"]["value"] == 1
+        assert snap[f"serving_latency_{cls}_seconds"]["count"] == 1
+    assert snap["serving_slo_served_total"]["value"] == 3
+    assert snap["serving_slo_good_total"]["value"] == 3
+    assert snap["serving_goodput_tokens_total"]["value"] \
+        == snap["serving_tokens_out_total"]["value"] == 9
+
+
+def test_request_log_schema_across_outcomes(export_dir, tmp_path):
+    """The satellite fix pinned: every JSONL event — ok AND the
+    failure outcomes that predate it — carries request_id, priority,
+    deadline_ms, outcome, slo_good, tokens, total_ms; ok events keep
+    the full phase breakdown."""
+    d, vocab = export_dir
+    log_path = str(tmp_path / "req.jsonl")
+    logger = MetricsLogger(log_path)
+    # shed_policy off: the feasibility rule would SHED the 1ms-
+    # deadline request before it could expire (correct behavior —
+    # PR 14 — but this test needs the expiry outcome)
+    eng = GenerationEngine(load_stepwise(d), shed_policy="off",
+                           metrics_logger=logger).start()
+    try:
+        # ok
+        eng.submit(_prompt(vocab), max_new=2,
+                   priority="batch").result(timeout=120)
+        # expired: a 1ms deadline the scheduler sweeps between steps
+        with pytest.raises(DeadlineExceededError):
+            eng.submit(_prompt(vocab, seed=1), max_new=MAX_NEW,
+                       deadline_ms=1).result(timeout=120)
+    finally:
+        eng.close()
+        logger.close()
+    # shed + cancelled: queued-path outcomes on an UNSTARTED engine
+    # (no scheduler race — the queue holds them until we act)
+    logger2 = MetricsLogger(log_path)
+    eng2 = GenerationEngine(load_stepwise(d), metrics_logger=logger2)
+    try:
+        h_shed = eng2.submit(_prompt(vocab, seed=2), max_new=2,
+                             priority="best_effort")
+        h_cans = eng2.submit(_prompt(vocab, seed=3), max_new=2,
+                             request_id="cancel-me")
+        eng2._shed_queued(
+            lambda r: r.request_id == h_shed.request_id,
+            reason="test shed")
+        assert eng2.cancel("cancel-me")
+        with pytest.raises(ShedError):
+            h_shed.result(timeout=5)
+        with pytest.raises(RequestCancelledError):
+            h_cans.result(timeout=5)
+        snap = eng2.metrics_snapshot()
+        assert snap["serving_slo_served_best_effort_total"][
+            "value"] == 1
+        assert snap["serving_slo_good_best_effort_total"][
+            "value"] == 0
+    finally:
+        eng2.close()
+        logger2.close()
+    events = [json.loads(ln) for ln in open(log_path)]
+    events = [e for e in events if e.get("event") == "generate"]
+    by_outcome = {e["outcome"]: e for e in events}
+    assert set(by_outcome) == {"ok", "expired", "shed", "cancelled"}
+    for e in events:
+        for key in ("request_id", "priority", "deadline_ms",
+                    "outcome", "slo_good", "tokens", "total_ms"):
+            assert key in e, (e["outcome"], key)
+    ok = by_outcome["ok"]
+    assert ok["priority"] == "batch" and ok["slo_good"] is True
+    assert ok["tokens"] == 2
+    for key in ("queue_ms", "prefill_ms", "decode_ms"):
+        assert key in ok
+    assert by_outcome["expired"]["deadline_ms"] == 1
+    assert by_outcome["expired"]["slo_good"] is False
+    assert by_outcome["shed"]["priority"] == "best_effort"
+    assert by_outcome["cancelled"]["request_id"] == "cancel-me"
+
+
+def test_goodput_excludes_deadline_missed_tokens(export_dir):
+    """A request that retires past its deadline is served-not-good:
+    its tokens stay OUT of serving_goodput_tokens_total while
+    serving_tokens_out_total keeps counting them."""
+    d, vocab = export_dir
+    eng = GenerationEngine(load_stepwise(d),
+                           shed_policy="off").start()
+    try:
+        eng.submit(_prompt(vocab), max_new=2).result(timeout=120)
+        with pytest.raises(DeadlineExceededError):
+            eng.submit(_prompt(vocab, seed=1), max_new=MAX_NEW,
+                       deadline_ms=1).result(timeout=120)
+        snap = eng.metrics_snapshot()
+    finally:
+        eng.close()
+    assert snap["serving_slo_served_total"]["value"] == 2
+    assert snap["serving_slo_good_total"]["value"] == 1
+    assert snap["serving_goodput_tokens_total"]["value"] == 2
+    assert snap["serving_tokens_out_total"]["value"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# serving_http: /stats/history, /healthz advisory, slo_burn incident
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_stats_history_off_by_default(export_dir):
+    d, _ = export_dir
+    with PredictServer(d) as srv:
+        body = _get(srv.port, "/stats/history")
+        assert body["enabled"] is False and body["samples"] == []
+        assert "slo" not in _get(srv.port, "/healthz")
+
+
+def test_slo_spec_requires_history_sampler(export_dir):
+    d, _ = export_dir
+    with pytest.raises(ValueError, match="history_interval_s"):
+        PredictServer(d, slo_spec="interactive:hit_rate=0.9")
+    with pytest.raises(ValueError, match="history_interval_s"):
+        PredictServer(d, history_interval_s=-1.0)
+
+
+def test_p95_target_beyond_bucket_coverage_refused(export_dir):
+    """A p95_ms target past the latency histograms' largest finite
+    bucket (60 s) is unmeasurable — +Inf-bucket observations cannot be
+    classified against it, and the pessimistic count would page
+    spurious breaches forever. Arm time refuses it loudly."""
+    d, _ = export_dir
+    with pytest.raises(ValueError, match="finite bucket"):
+        PredictServer(d, history_interval_s=3600.0,
+                      slo_spec="interactive:p95_ms=120000@0.9")
+    # at the bound is fine (scheduler off = no engine thread; close
+    # the never-served listener socket directly — shutdown() would
+    # hang without a running serve_forever, the round-15 lesson)
+    srv = PredictServer(d, scheduler="off",
+                        history_interval_s=3600.0,
+                        slo_spec="interactive:p95_ms=60000@0.9")
+    srv._httpd.server_close()
+
+
+def test_history_endpoint_healthz_advisory_and_slo_burn(export_dir,
+                                                        tmp_path):
+    """The deterministic burn story end-to-end: baseline sample at
+    start(), one expired request (err=1 against a 0.9 goal -> burn 10
+    over both windows), first poll writes exactly one slo_burn bundle
+    (snapshot consistent with the registry), second poll is
+    rate-limit suppressed, /healthz carries the advisory block but
+    STAYS 200-worthy (status live)."""
+    d, vocab = export_dir
+    inc_dir = str(tmp_path / "incidents")
+    with PredictServer(
+            d, incident_dir=inc_dir, shed_policy="off",
+            history_interval_s=3600.0, history_samples=32,
+            slo_spec="interactive:hit_rate=0.9",
+            slo_fast_window_s=7200.0, slo_slow_window_s=7200.0,
+            slo_burn_threshold=2.0) as srv:
+        deadline = time.monotonic() + 5.0
+        while len(srv._sampler) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)               # start()'s baseline capture
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/models/{srv.name}"
+            ":generate",
+            data=json.dumps({
+                "inputs": {"input_ids":
+                           [_prompt(vocab).tolist()]},
+                "max_new": MAX_NEW, "deadline_ms": 1}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 504
+        ei.value.read()
+        body = _get(srv.port, "/stats/history")     # poll 1: breach
+        assert body["enabled"] is True
+        assert len(body["samples"]) >= 2
+        results = body["slo"]["results"]
+        assert [r["class"] for r in results] == ["interactive"]
+        assert results[0]["breach"] is True
+        assert results[0]["attainment"] == 0.0
+        bundles = [b for b in os.listdir(inc_dir)
+                   if "-slo_burn-" in b]
+        assert len(bundles) == 1
+        with open(os.path.join(inc_dir, bundles[0])) as f:
+            bundle = json.load(f)
+        assert bundle["cause"] == "slo_burn"
+        assert bundle["slo"][0]["breach"] is True
+        assert bundle["history_tail"]
+        # the embedded registry snapshot is the same atomic read the
+        # live page renders: the SLO counters must agree exactly
+        reg = bundle["registry"]
+        assert reg["serving_slo_served_interactive_total"][
+            "value"] == 1
+        assert reg["serving_slo_good_interactive_total"]["value"] == 0
+        assert reg["serving_incidents_total"]["value"] == 1
+        # poll 2: still breaching, suppressed by the per-cause limit
+        _get(srv.port, "/stats/history")
+        assert len([b for b in os.listdir(inc_dir)
+                    if "-slo_burn-" in b]) == 1
+        # polls are EPHEMERAL: two polls later the ring still holds
+        # only the start() baseline — pollers cannot erode the
+        # coverage the burn windows were sized for
+        assert len(srv._sampler) == 1
+        h = _get(srv.port, "/healthz")
+        assert h["status"] == "live"
+        assert h["slo"]["breaching"] == ["interactive:hit_rate"]
+        assert h["slo"]["worst_burn"]["burn_fast"] >= 2.0
+        snap = srv._metrics_snapshot()
+        assert snap["serving_incidents_suppressed_total"]["value"] \
+            >= 1
+
+
+# ---------------------------------------------------------------------------
+# router: the fleet rollup
+# ---------------------------------------------------------------------------
+
+class _FakeReplica:
+    """A canned /healthz + /stats/history endpoint whose history sits
+    in a clock running OFFSET seconds ahead of the router's — the
+    rollup must correct it back."""
+
+    def __init__(self, served, offset=0.0):
+        fake = self
+        self.offset = float(offset)
+
+        def snap(n):
+            reg = Registry()
+            reg.counter("serving_slo_served_total").inc(n)
+            reg.counter("serving_slo_good_total").inc(n)
+            return reg.snapshot()
+
+        # sample stamps sit at BIN CENTERS of the 10s rollup grid (in
+        # the router's clock), so a millisecond of offset-estimate
+        # error can never push a sample across a bin boundary and
+        # flake the alignment assertion
+        base = time.perf_counter()
+        center = (int(base // 10) + 2) * 10.0 + 5.0
+        self.samples = [
+            [center + self.offset - 10.0, snap(0)],
+            [center + self.offset, snap(served)]]
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    body = json.dumps({
+                        "status": "live", "draining": False,
+                        "mono_now": time.perf_counter()
+                        + fake.offset}).encode()
+                elif self.path == "/stats/history":
+                    body = json.dumps({
+                        "enabled": True, "process": "serving",
+                        "interval_s": 10.0,
+                        "clock": time.perf_counter() + fake.offset,
+                        "samples": fake.samples}).encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_router_history_rollup_aligns_clocks_and_merges():
+    a, b = _FakeReplica(served=3), _FakeReplica(served=5,
+                                                offset=500.0)
+    router = ReplicaRouter(
+        [Replica(f"http://127.0.0.1:{a.port}", name="replica0"),
+         Replica(f"http://127.0.0.1:{b.port}", name="replica1")],
+        probe_interval_s=0.05).start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len(router.clock_samples().get("replica1", ())) >= 3:
+                break
+            time.sleep(0.02)
+        out = router.stats_history()
+    finally:
+        router.close()
+        a.close()
+        b.close()
+    assert out["enabled"] is True and out["process"] == "router"
+    # replica1's ~500s skew is estimated off the probe stamps and
+    # corrected: both replicas' samples land in the same bins
+    assert out["clock_offsets_s"]["replica1"] == pytest.approx(
+        500.0, abs=1.0)
+    assert out["clock_offsets_s"]["replica0"] == pytest.approx(
+        0.0, abs=1.0)
+    merged = out["samples"]
+    assert len(merged) == 2
+    assert [s["serving_slo_served_total"]["value"]
+            for _, s in merged] == [0, 8]
+    # per-replica payloads ride beside the merge, timestamps already
+    # corrected into the router clock
+    r1 = out["replicas"]["replica1"]
+    assert r1["clock_offset_s"] == pytest.approx(500.0, abs=1.0)
+    t_corr = r1["samples"][-1][0]
+    t_raw = time.perf_counter() + 500.0
+    assert abs(t_raw - t_corr) > 400.0      # correction actually applied
+
+
+def test_router_history_survives_dead_replica():
+    a = _FakeReplica(served=2)
+    router = ReplicaRouter(
+        [Replica(f"http://127.0.0.1:{a.port}", name="replica0"),
+         Replica("http://127.0.0.1:1", name="replica1")],
+        probe_interval_s=0.05, dead_after_probes=1).start()
+    try:
+        out = router.stats_history()
+    finally:
+        router.close()
+        a.close()
+    assert out["enabled"] is True
+    assert "error" in out["replicas"]["replica1"]
+    assert [s["serving_slo_served_total"]["value"]
+            for _, s in out["samples"]] == [0, 2]
